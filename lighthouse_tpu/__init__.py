@@ -1,0 +1,21 @@
+"""lighthouse_tpu — a TPU-native Ethereum consensus client framework.
+
+A from-scratch re-design of the capabilities of Lighthouse (the Rust consensus
+client, reference mounted at /root/reference) around JAX/XLA/Pallas: batch
+BLS12-381 signature verification runs as vmapped/sharded device kernels behind
+the same pluggable backend seam as the reference's crypto/bls crate, fed by
+fixed-shape signature-set tensors staged from the verification pipelines.
+
+Package map (SURVEY.md layer map -> here):
+    crypto/           L0 oracle: pure-Python BLS (ground truth + CPU fallback)
+    ops/              L0 device: JAX limb arithmetic, curve/pairing kernels
+    parallel/         mesh/sharding for batch-axis data parallelism over ICI
+    types/            L1: SSZ, consensus containers, ChainSpec presets
+    state_transition/ L2: pure per-slot/per-block/epoch processing
+    fork_choice/      L3: proto-array DAG
+    store/            L5: hot/cold storage
+    processor/        L7: priority scheduler + batch former
+    models/           flagship staged batch-verifier pipeline
+"""
+
+__version__ = "0.1.0"

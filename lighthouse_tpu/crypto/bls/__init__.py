@@ -1,0 +1,44 @@
+"""BLS12-381 signatures for the Ethereum consensus layer, TPU-first.
+
+Layering (mirrors reference crypto/bls crate structure, lib.rs:99-163):
+    constants  — public curve/ciphersuite parameters
+    fields     — Fp/Fp2/Fp6/Fp12 tower (pure-Python oracle)
+    curves     — G1/G2 group ops, serialization, subgroup checks
+    pairing    — optimal ate multi-pairing
+    hash_to_curve — RFC 9380 G2 ciphersuite
+    api        — SecretKey/PublicKey/Signature/SignatureSet + backend seam
+"""
+
+from .api import (
+    AggregatePublicKey,
+    AggregateSignature,
+    BlsError,
+    PublicKey,
+    SecretKey,
+    Signature,
+    SignatureSet,
+    aggregate_verify,
+    fast_aggregate_verify,
+    get_backend,
+    register_backend,
+    set_backend,
+    verify,
+    verify_signature_sets,
+)
+
+__all__ = [
+    "AggregatePublicKey",
+    "AggregateSignature",
+    "BlsError",
+    "PublicKey",
+    "SecretKey",
+    "Signature",
+    "SignatureSet",
+    "aggregate_verify",
+    "fast_aggregate_verify",
+    "get_backend",
+    "register_backend",
+    "set_backend",
+    "verify",
+    "verify_signature_sets",
+]

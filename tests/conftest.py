@@ -1,0 +1,14 @@
+"""Test configuration.
+
+JAX tests run on a virtual 8-device CPU mesh (multi-chip hardware is not
+available in CI; the sharding layer is designed for a real TPU mesh and
+validated here on forced host devices). Must run before jax is imported.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")

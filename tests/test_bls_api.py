@@ -1,0 +1,173 @@
+"""Signature API tests: interop vectors, verification semantics, batch
+verification incl. poisoning, backend seam."""
+
+import pytest
+
+from lighthouse_tpu.crypto.bls import (
+    AggregateSignature,
+    BlsError,
+    PublicKey,
+    SecretKey,
+    Signature,
+    SignatureSet,
+    aggregate_verify,
+    fast_aggregate_verify,
+    get_backend,
+    set_backend,
+    verify,
+    verify_signature_sets,
+)
+from lighthouse_tpu.crypto.bls import curves as c
+
+# First three vectors from the reference's interop keypair spec
+# (common/eth2_interop_keypairs/specs/keygen_10_validators.yaml).
+INTEROP_VECTORS = [
+    ("25295f0d1d592a90b333e26e85149708208e9f8e8bc18f6c77bd62f8ad7a6866",
+     "a99a76ed7796f7be22d5b7e85deeb7c5677e88e511e0b337618f8c4eb61349b4bf2d153f649f7b53359fe8b94a38e44c"),
+    ("51d0b65185db6989ab0b560d6deed19c7ead0e24b9b6372cbecb1f26bdfad000",
+     "b89bebc699769726a318c8e9971bd3171297c61aea4a6578a7a4f94b547dcba5bac16a89108b6b6a1fe3695d1a874a0b"),
+    ("315ed405fafe339603932eebe8dbfd650ce5dafa561f6928664c75db85f97857",
+     "a3a32b0f8b4ddb83f1a0a853d81dd725dfe577d4f4c3db8ece52ce2b026eca84815c1a7e8e92a4de3d755733bf7e4a9b"),
+]
+
+
+def sk(i=0):
+    return SecretKey.from_bytes(bytes.fromhex(INTEROP_VECTORS[i][0]))
+
+
+def test_interop_keypair_vectors():
+    for sk_hex, pk_hex in INTEROP_VECTORS:
+        s = SecretKey.from_bytes(bytes.fromhex(sk_hex))
+        assert s.public_key().to_bytes().hex() == pk_hex
+
+
+def test_sign_verify_roundtrip():
+    msg = b"\x42" * 32
+    sig = sk().sign(msg)
+    assert verify(sk().public_key(), msg, sig)
+    assert not verify(sk().public_key(), b"\x43" * 32, sig)
+    assert not verify(sk(1).public_key(), msg, sig)
+
+
+def test_signature_serialization_roundtrip():
+    sig = sk().sign(b"\x01" * 32)
+    sig2 = Signature.from_bytes(sig.to_bytes())
+    assert sig2.point == sig.point
+
+
+def test_infinity_signature_never_verifies():
+    assert not verify(sk().public_key(), b"\x00" * 32, Signature.infinity())
+    inf_bytes = Signature.infinity().to_bytes()
+    assert inf_bytes[0] == 0xC0
+    assert Signature.from_bytes(inf_bytes).point is None
+
+
+def test_infinity_pubkey_rejected():
+    """Matches reference generic_public_key.rs infinity rejection."""
+    inf = bytes([0xC0]) + b"\x00" * 47
+    with pytest.raises(BlsError):
+        PublicKey.from_bytes(inf)
+
+
+def test_non_subgroup_signature_rejected():
+    # Build an on-curve, non-subgroup G2 point and serialize it.
+    import random
+
+    from lighthouse_tpu.crypto.bls import fields as f
+    from lighthouse_tpu.crypto.bls.constants import P
+
+    rng = random.Random(5)
+    while True:
+        x = (rng.randrange(P), rng.randrange(P))
+        y2 = f.fp2_add(f.fp2_mul(f.fp2_sqr(x), x), c.B2)
+        y = f.fp2_sqrt(y2)
+        if y is not None and not c.g2_in_subgroup((x, y)):
+            break
+    data = c.g2_to_compressed((x, y))
+    with pytest.raises(BlsError):
+        Signature.from_bytes(data)
+    sig = Signature.from_bytes(data, subgroup_check=False)
+    assert not verify(sk().public_key(), b"\x00" * 32, sig)
+
+
+def test_fast_aggregate_verify():
+    msg = b"\x07" * 32
+    sks = [sk(i) for i in range(3)]
+    agg = AggregateSignature.aggregate([s.sign(msg) for s in sks])
+    pks = [s.public_key() for s in sks]
+    assert fast_aggregate_verify(pks, msg, Signature(point=agg.point))
+    assert not fast_aggregate_verify(pks[:2], msg, Signature(point=agg.point))
+    assert not fast_aggregate_verify([], msg, Signature(point=agg.point))
+
+
+def test_aggregate_verify_distinct_messages():
+    sks = [sk(i) for i in range(3)]
+    msgs = [bytes([i]) * 32 for i in range(3)]
+    agg = AggregateSignature.aggregate([s.sign(m) for s, m in zip(sks, msgs)])
+    pks = [s.public_key() for s in sks]
+    assert aggregate_verify(pks, msgs, Signature(point=agg.point))
+    assert not aggregate_verify(pks, list(reversed(msgs)), Signature(point=agg.point))
+
+
+def make_sets(n, poison_last=False):
+    sets = []
+    for i in range(n):
+        s = sk(i % len(INTEROP_VECTORS))
+        msg = bytes([i]) * 32
+        sets.append(SignatureSet(signature=s.sign(msg), signing_keys=[s.public_key()], message=msg))
+    if poison_last:
+        bad = SignatureSet(
+            signature=sk(0).sign(b"\x99" * 32),
+            signing_keys=[sk(1).public_key()],
+            message=b"\x99" * 32,
+        )
+        sets[-1] = bad
+    return sets
+
+
+def test_batch_verify():
+    assert verify_signature_sets(make_sets(4))
+
+
+def test_batch_verify_poisoned_fails_and_fallback_identifies():
+    sets = make_sets(4, poison_last=True)
+    assert not verify_signature_sets(sets)
+    # Fallback: per-set verification finds the culprit
+    # (reference attestation_verification/batch.rs:123-134 semantics).
+    results = [
+        fast_aggregate_verify(list(s.signing_keys), s.message, s.signature)
+        for s in sets
+    ]
+    assert results == [True, True, True, False]
+
+
+def test_batch_verify_empty_inputs():
+    assert not verify_signature_sets([])
+    empty_keys = SignatureSet(signature=sk().sign(b"\x01" * 32), signing_keys=[], message=b"\x01" * 32)
+    assert not verify_signature_sets([empty_keys])
+
+
+def test_multi_key_set():
+    msg = b"\x2a" * 32
+    sks = [sk(i) for i in range(3)]
+    agg_sig = AggregateSignature.aggregate([s.sign(msg) for s in sks])
+    st = SignatureSet(
+        signature=Signature(point=agg_sig.point),
+        signing_keys=[s.public_key() for s in sks],
+        message=msg,
+    )
+    assert verify_signature_sets([st])
+
+
+def test_fake_backend():
+    assert get_backend() == "oracle"
+    try:
+        set_backend("fake")
+        assert verify_signature_sets(make_sets(2, poison_last=True))
+    finally:
+        set_backend("oracle")
+
+
+def test_unknown_backend_rejected():
+    with pytest.raises(BlsError):
+        set_backend("nonsense")

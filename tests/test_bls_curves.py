@@ -1,0 +1,165 @@
+"""G1/G2 group law, subgroup-check, psi-endomorphism, and serialization tests."""
+
+import random
+
+import pytest
+
+from lighthouse_tpu.crypto.bls import curves as c
+from lighthouse_tpu.crypto.bls import fields as f
+from lighthouse_tpu.crypto.bls.constants import BLS_X_ABS, H_EFF_G2, P, R
+
+rng = random.Random(99)
+
+
+def rand_g1():
+    return c.g1_mul(c.G1_GEN, rng.randrange(1, R))
+
+
+def rand_g2():
+    return c.g2_mul(c.G2_GEN, rng.randrange(1, R))
+
+
+def rand_e2_point():
+    """A random point on E2 but (whp) NOT in the r-order subgroup."""
+    while True:
+        x = (rng.randrange(P), rng.randrange(P))
+        y2 = f.fp2_add(f.fp2_mul(f.fp2_sqr(x), x), c.B2)
+        y = f.fp2_sqrt(y2)
+        if y is not None:
+            return (x, y)
+
+
+def test_generators_on_curve():
+    assert c.g1_is_on_curve(c.G1_GEN)
+    assert c.g2_is_on_curve(c.G2_GEN)
+
+
+def test_group_law_g1():
+    a, b = rand_g1(), rand_g1()
+    assert c.g1_add(a, b) == c.g1_add(b, a)
+    assert c.g1_add(a, None) == a
+    assert c.g1_add(a, c.g1_neg(a)) is None
+    # (k1 + k2) P == k1 P + k2 P
+    k1, k2 = rng.randrange(R), rng.randrange(R)
+    assert c.g1_mul(c.G1_GEN, (k1 + k2) % R) == c.g1_add(
+        c.g1_mul(c.G1_GEN, k1), c.g1_mul(c.G1_GEN, k2)
+    )
+
+
+def test_group_law_g2():
+    a, b = rand_g2(), rand_g2()
+    assert c.g2_add(a, b) == c.g2_add(b, a)
+    assert c.g2_add(a, c.g2_neg(a)) is None
+    k1, k2 = rng.randrange(R), rng.randrange(R)
+    assert c.g2_mul(c.G2_GEN, (k1 + k2) % R) == c.g2_add(
+        c.g2_mul(c.G2_GEN, k1), c.g2_mul(c.G2_GEN, k2)
+    )
+
+
+def test_subgroup_order():
+    assert c.g1_mul(c.G1_GEN, R) is None
+    assert c.g2_mul(c.G2_GEN, R) is None
+
+
+def test_psi_eigenvalue_on_subgroup():
+    """On G2, psi acts as multiplication by p (Frobenius eigenvalue)."""
+    q = rand_g2()
+    assert c.g2_psi(q) == c.g2_mul(q, P % R)
+
+
+def test_g2_subgroup_check_accepts_subgroup_rejects_cofactor():
+    assert c.g2_in_subgroup(rand_g2())
+    # Random curve points are in the full E2 group (order h2 * r); whp not in G2.
+    for _ in range(3):
+        pt = rand_e2_point()
+        assert c.g2_is_on_curve(pt)
+        assert not c.g2_in_subgroup(pt)
+    # The psi check must agree with the ground-truth full-order check.
+    pt = rand_e2_point()
+    ground_truth = c.g2_mul(pt, R) is None
+    assert c.g2_in_subgroup(pt) == ground_truth
+
+
+def test_g1_subgroup_check_rejects_cofactor_points():
+    """Regression: non-subgroup on-curve G1 points must be rejected
+    (the check multiplies by the unreduced group order)."""
+    assert c.g1_in_subgroup(rand_g1())
+    found = 0
+    while found < 3:
+        x = rng.randrange(P)
+        y = f.fp_sqrt((x * x * x + 4) % P)
+        if y is None:
+            continue
+        pt = (x, y)
+        assert c.g1_in_subgroup(pt) == (c.g1_mul(pt, R) is None)
+        found += 1
+
+
+def test_clear_cofactor_lands_in_subgroup():
+    for _ in range(3):
+        pt = rand_e2_point()
+        cleared = c.g2_clear_cofactor(pt)
+        assert c.g2_in_subgroup(cleared)
+
+
+def test_h_eff_matches_psi_decomposition():
+    """h_eff multiplication == [x^2-x-1]P + [x-1]psi(P) + psi(psi(2P))
+    (Budroni–Pintore fast cofactor clearing; x = -|x| for BLS12-381).
+
+    This cross-validates the memorized H_EFF_G2 constant against an
+    independently derived formula."""
+    x = -BLS_X_ABS
+    for _ in range(2):
+        pt = rand_e2_point()
+        lhs = c.g2_mul(pt, H_EFF_G2)
+        rhs = c.g2_add(
+            c.g2_add(
+                c.g2_mul(pt, x * x - x - 1),
+                c.g2_psi(c.g2_mul(pt, x - 1)),
+            ),
+            c.g2_psi(c.g2_psi(c.g2_mul(pt, 2))),
+        )
+        assert lhs == rhs
+
+
+def test_g1_serialization_roundtrip():
+    for _ in range(5):
+        pt = rand_g1()
+        data = c.g1_to_compressed(pt)
+        assert len(data) == 48
+        assert c.g1_from_compressed(data) == pt
+    assert c.g1_from_compressed(c.g1_to_compressed(None)) is None
+
+
+def test_g2_serialization_roundtrip():
+    for _ in range(5):
+        pt = rand_g2()
+        data = c.g2_to_compressed(pt)
+        assert len(data) == 96
+        assert c.g2_from_compressed(data) == pt
+    assert c.g2_from_compressed(c.g2_to_compressed(None)) is None
+
+
+def test_malformed_deserialization_rejected():
+    with pytest.raises(ValueError):
+        c.g1_from_compressed(b"\x00" * 48)  # compression bit unset
+    with pytest.raises(ValueError):
+        c.g1_from_compressed(b"\xff" * 48)  # x >= p
+    with pytest.raises(ValueError):
+        # non-canonical infinity (sign bit set)
+        c.g1_from_compressed(bytes([0xE0]) + b"\x00" * 47)
+    with pytest.raises(ValueError):
+        # infinity with nonzero tail
+        c.g1_from_compressed(bytes([0xC0]) + b"\x00" * 46 + b"\x01")
+    with pytest.raises(ValueError):
+        c.g2_from_compressed(b"\x00" * 96)
+    # x not on curve: find one
+    data = bytearray(c.g1_to_compressed(rand_g1()))
+    for probe in range(256):
+        data[-1] = probe
+        try:
+            c.g1_from_compressed(bytes(data))
+        except ValueError:
+            break
+    else:
+        pytest.fail("expected some x to be off-curve")

@@ -1,0 +1,166 @@
+"""Hash-to-curve tests, including structural cross-validation of the 3-isogeny
+constants against an independent Vélu derivation.
+
+Rationale: consensus-spec BLS vectors are not available offline, so the RFC 9380
+Appendix E.3 constants in constants.py are validated three independent ways:
+  1. the SSWU output lies on E2' and the iso image lies on E2 (a single
+     corrupted hex digit breaks this with overwhelming probability);
+  2. the iso map is a group homomorphism E2' -> E2;
+  3. the constants satisfy the exact algebraic relations of a Vélu 3-isogeny
+     composed with a scaling isomorphism (kernel root recovered from the
+     denominator, image curve coefficients recomputed from first principles).
+"""
+
+import random
+
+from lighthouse_tpu.crypto.bls import curves as c
+from lighthouse_tpu.crypto.bls import fields as f
+from lighthouse_tpu.crypto.bls import hash_to_curve as h2c
+from lighthouse_tpu.crypto.bls.constants import (
+    ISO3_X_DEN,
+    ISO3_X_NUM,
+    ISO3_Y_DEN,
+    ISO3_Y_NUM,
+    P,
+    SSWU_A2,
+    SSWU_B2,
+)
+
+rng = random.Random(7)
+
+
+def rand_e2prime_point():
+    """Random point on E2': y^2 = x^3 + A'x + B'."""
+    while True:
+        x = (rng.randrange(P), rng.randrange(P))
+        y2 = f.fp2_add(f.fp2_mul(f.fp2_add(f.fp2_sqr(x), SSWU_A2), x), SSWU_B2)
+        y = f.fp2_sqrt(y2)
+        if y is not None:
+            return (x, y)
+
+
+def eprime_add(p1, p2):
+    """Affine addition on E2' (generic short-Weierstrass with a=A')."""
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    (x1, y1), (x2, y2) = p1, p2
+    if x1 == x2:
+        if y1 == f.fp2_neg(y2):
+            return None
+        slope = f.fp2_mul(
+            f.fp2_add(f.fp2_mul_scalar(f.fp2_sqr(x1), 3), SSWU_A2),
+            f.fp2_inv(f.fp2_mul_scalar(y1, 2)),
+        )
+    else:
+        slope = f.fp2_mul(f.fp2_sub(y2, y1), f.fp2_inv(f.fp2_sub(x2, x1)))
+    x3 = f.fp2_sub(f.fp2_sub(f.fp2_sqr(slope), x1), x2)
+    y3 = f.fp2_sub(f.fp2_mul(slope, f.fp2_sub(x1, x3)), y1)
+    return (x3, y3)
+
+
+def test_sswu_output_on_eprime():
+    for msg in [b"a", b"b", b"\x00" * 32]:
+        u0, u1 = h2c.hash_to_field_fp2(msg, 2)
+        for u in (u0, u1):
+            x, y = h2c.map_to_curve_simple_swu_g2(u)
+            lhs = f.fp2_sqr(y)
+            rhs = f.fp2_add(f.fp2_mul(f.fp2_add(f.fp2_sqr(x), SSWU_A2), x), SSWU_B2)
+            assert lhs == rhs
+
+
+def test_iso_image_on_e2():
+    for _ in range(5):
+        pt = rand_e2prime_point()
+        img = h2c.iso_map_g2(pt)
+        assert img is not None and c.g2_is_on_curve(img)
+
+
+def test_iso_is_homomorphism():
+    for _ in range(3):
+        p1, p2 = rand_e2prime_point(), rand_e2prime_point()
+        lhs = h2c.iso_map_g2(eprime_add(p1, p2))
+        rhs = c.g2_add(h2c.iso_map_g2(p1), h2c.iso_map_g2(p2))
+        assert lhs == rhs
+
+
+def test_iso_constants_match_velu_derivation():
+    """Recover the kernel from ISO3_X_DEN and rebuild every coefficient list
+    from Vélu's formulas; they must match the RFC constants exactly."""
+    # x_den must be (x - x0)^2: monic, k2_1 = -2 x0, k2_0 = x0^2.
+    assert ISO3_X_DEN[2] == f.FP2_ONE
+    x0 = f.fp2_mul_scalar(f.fp2_neg(ISO3_X_DEN[1]), pow(2, P - 2, P))
+    assert f.fp2_sqr(x0) == ISO3_X_DEN[0]
+    # x0 must be a root of the 3-division polynomial of E2':
+    # psi_3(x) = 3x^4 + 6A'x^2 + 12B'x - A'^2.
+    x0_2 = f.fp2_sqr(x0)
+    psi3 = f.fp2_sub(
+        f.fp2_add(
+            f.fp2_add(
+                f.fp2_mul_scalar(f.fp2_sqr(x0_2), 3),
+                f.fp2_mul_scalar(f.fp2_mul(SSWU_A2, x0_2), 6),
+            ),
+            f.fp2_mul_scalar(f.fp2_mul(SSWU_B2, x0), 12),
+        ),
+        f.fp2_sqr(SSWU_A2),
+    )
+    assert f.fp2_is_zero(psi3), "kernel abscissa is not an order-3 x-coordinate"
+
+    # Vélu quantities for the single kernel x-coordinate (Washington, §12.3,
+    # short Weierstrass b2=0, b4=2A', b6=4B'):
+    t = f.fp2_add(f.fp2_mul_scalar(x0_2, 6), f.fp2_mul_scalar(SSWU_A2, 2))
+    u_v = f.fp2_mul_scalar(
+        f.fp2_add(f.fp2_mul(f.fp2_add(x0_2, SSWU_A2), x0), SSWU_B2), 4
+    )  # 4 * g(x0) = 4 y0^2
+    # Unscaled Vélu x-map numerator: x^3 - 2 x0 x^2 + (x0^2 + t) x + (u - t x0).
+    c2 = ISO3_X_NUM[3]  # scaling c^2 (the map is Vélu composed with (x,y)->(c^2 x, c^3 y))
+    expect_x_num = [
+        f.fp2_mul(c2, f.fp2_sub(u_v, f.fp2_mul(t, x0))),
+        f.fp2_mul(c2, f.fp2_add(x0_2, t)),
+        f.fp2_mul(c2, f.fp2_mul_scalar(f.fp2_neg(x0), 2)),
+        c2,
+    ]
+    assert list(ISO3_X_NUM) == expect_x_num, "x_num does not match Vélu derivation"
+
+    # y-map: c^3 * [(x-x0)^3 - t(x-x0) - 2u] / (x-x0)^3.
+    c3 = ISO3_Y_NUM[3]
+    assert f.fp2_sqr(c3) == f.fp2_mul(f.fp2_sqr(c2), c2), "c^3 inconsistent with c^2"
+    # y_den == (x - x0)^3
+    m3x0 = f.fp2_neg(x0)
+    expect_y_den = [
+        f.fp2_mul(f.fp2_sqr(m3x0), m3x0),
+        f.fp2_mul_scalar(x0_2, 3),
+        f.fp2_mul_scalar(m3x0, 3),
+        f.FP2_ONE,
+    ]
+    assert list(ISO3_Y_DEN) == expect_y_den, "y_den does not match (x-x0)^3"
+    # y_num == c^3 * expansion of (x-x0)^3 - t(x-x0) - 2u
+    expect_y_num = [
+        f.fp2_mul(c3, f.fp2_sub(f.fp2_add(expect_y_den[0], f.fp2_mul(t, x0)), f.fp2_mul_scalar(u_v, 2))),
+        f.fp2_mul(c3, f.fp2_sub(expect_y_den[1], t)),
+        f.fp2_mul(c3, expect_y_den[2]),
+        c3,
+    ]
+    assert list(ISO3_Y_NUM) == expect_y_num, "y_num does not match Vélu derivation"
+
+
+def test_hash_to_g2_lands_in_subgroup():
+    for msg in [b"", b"hello", b"\xff" * 32]:
+        pt = h2c.hash_to_g2(msg)
+        assert c.g2_is_on_curve(pt)
+        assert c.g2_in_subgroup(pt)
+
+
+def test_hash_deterministic_and_dst_separated():
+    assert h2c.hash_to_g2(b"m") == h2c.hash_to_g2(b"m")
+    assert h2c.hash_to_g2(b"m") != h2c.hash_to_g2(b"m", dst=b"OTHER_DST_")
+
+
+def test_expand_message_xmd_lengths():
+    out = h2c.expand_message_xmd(b"abc", b"DST", 128)
+    assert len(out) == 128
+    out2 = h2c.expand_message_xmd(b"abc", b"DST", 128)
+    assert out == out2
+    # length is part of the domain separation: different lengths differ
+    assert h2c.expand_message_xmd(b"abc", b"DST", 32) != out[:32]

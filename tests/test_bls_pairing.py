@@ -1,0 +1,59 @@
+"""Pairing tests: bilinearity, non-degeneracy, multi-pairing."""
+
+import random
+
+from lighthouse_tpu.crypto.bls import curves as c
+from lighthouse_tpu.crypto.bls import fields as f
+from lighthouse_tpu.crypto.bls import pairing as pr
+from lighthouse_tpu.crypto.bls.constants import R
+
+rng = random.Random(42)
+
+
+def test_nondegenerate_and_order():
+    e = pr.pairing(c.G1_GEN, c.G2_GEN)
+    assert e != f.FP12_ONE
+    assert f.fp12_pow(e, R) == f.FP12_ONE
+
+
+def test_bilinearity():
+    a = rng.randrange(1, R)
+    b = rng.randrange(1, R)
+    e = pr.pairing(c.G1_GEN, c.G2_GEN)
+    e_ab = pr.pairing(c.g1_mul(c.G1_GEN, a), c.g2_mul(c.G2_GEN, b))
+    assert e_ab == f.fp12_pow(e, a * b % R)
+
+
+def test_linearity_in_each_slot():
+    a = rng.randrange(1, R)
+    p_a = c.g1_mul(c.G1_GEN, a)
+    q = c.g2_mul(c.G2_GEN, rng.randrange(1, R))
+    lhs = pr.pairing(p_a, q)
+    rhs = f.fp12_pow(pr.pairing(c.G1_GEN, q), a)
+    assert lhs == rhs
+
+
+def test_pairing_with_infinity_is_one():
+    assert pr.pairing(None, c.G2_GEN) == f.FP12_ONE
+    assert pr.pairing(c.G1_GEN, None) == f.FP12_ONE
+
+
+def test_multi_pairing_product():
+    """prod e(a_i G1, G2) * e(-sum(a_i) G1, G2) == 1."""
+    scalars = [rng.randrange(1, R) for _ in range(3)]
+    pairs = [(c.g1_mul(c.G1_GEN, s), c.G2_GEN) for s in scalars]
+    total = sum(scalars) % R
+    pairs.append((c.g1_neg(c.g1_mul(c.G1_GEN, total)), c.G2_GEN))
+    assert pr.pairings_product_is_one(pairs)
+    pairs[-1] = (c.g1_neg(c.g1_mul(c.G1_GEN, (total + 1) % R)), c.G2_GEN)
+    assert not pr.pairings_product_is_one(pairs)
+
+
+def test_multi_miller_matches_product_of_singles():
+    p1 = c.g1_mul(c.G1_GEN, 11)
+    p2 = c.g1_mul(c.G1_GEN, 22)
+    q1 = c.g2_mul(c.G2_GEN, 33)
+    q2 = c.g2_mul(c.G2_GEN, 44)
+    joint = pr.final_exponentiation(pr.multi_miller_loop([(p1, q1), (p2, q2)]))
+    single = f.fp12_mul(pr.pairing(p1, q1), pr.pairing(p2, q2))
+    assert joint == single
