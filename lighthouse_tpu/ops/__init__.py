@@ -1,0 +1,31 @@
+"""Device-side (JAX/XLA/Pallas) kernels for BLS12-381 batch verification.
+
+This package is the TPU-native replacement for the reference's blst assembly
+(crypto/bls/src/impls/blst.rs): limb-decomposed 381-bit Montgomery arithmetic,
+field towers, curve ops, the multi-Miller loop and final exponentiation — all
+batched over a leading axis and shardable across a device mesh
+(lighthouse_tpu.parallel).
+
+64-bit integer support is required (limb products are accumulated in uint64);
+we enable jax x64 at import, before any array is created.
+"""
+
+import os
+
+if os.environ.get("LIGHTHOUSE_TPU_NO_X64") != "1":
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+# Persistent compilation cache: the pairing kernels are large graphs whose
+# first compile is tens of seconds; subsequent processes reuse the cache.
+try:
+    import jax
+
+    _cache_dir = os.environ.get(
+        "LIGHTHOUSE_TPU_JAX_CACHE", os.path.expanduser("~/.cache/lighthouse_tpu_jax")
+    )
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:  # pragma: no cover - cache is an optimization only
+    pass

@@ -1,0 +1,219 @@
+"""381-bit modular arithmetic as fixed-shape limb vectors (JAX).
+
+The TPU has no native big integers; an Fp element is a vector of L=15 limbs of
+B=26 bits held in uint64 lanes, shape ``(..., 15)``, in Montgomery form with
+R = 2^390. The 26-bit radix keeps schoolbook column sums far below 2^64
+(each product < 2^52, ≤15 terms per column, plus the Montgomery fold), so a
+single carry propagation per multiplication suffices.
+
+Compile-size discipline: a pairing traces tens of thousands of field
+multiplications, so every op here must lower to a *constant, small* number of
+HLO ops regardless of L:
+  * products use a Toeplitz gather (b[IDX] * mask * a, one reduce) — 4 ops,
+    not an unrolled 225-term double loop;
+  * carry/borrow propagation uses lax.scan over the column axis — 1 op.
+
+This replaces the reference's blst assembly field layer (crypto/bls/src/
+impls/blst.rs links Supranational blst; SURVEY.md §2.7). Differentially
+tested against the pure-Python oracle (lighthouse_tpu.crypto.bls.fields).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.bls.constants import P
+
+# --- Limb layout ---------------------------------------------------------------
+
+B = 26                      # bits per limb
+L = 15                      # limbs per Fp element (15*26 = 390 >= 381)
+MASK = (1 << B) - 1
+NBITS = L * B               # 390
+NCOLS = 2 * L - 1           # columns of a schoolbook product
+R_MONT = 1 << NBITS         # Montgomery radix
+R2_INT = R_MONT * R_MONT % P
+NPRIME_INT = (-pow(P, -1, R_MONT)) % R_MONT     # -p^-1 mod 2^390
+
+DTYPE = jnp.uint64
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Host-side: Python int -> limb vector (numpy uint64)."""
+    out = np.zeros(L, dtype=np.uint64)
+    for i in range(L):
+        out[i] = (x >> (B * i)) & MASK
+    return out
+
+
+def limbs_to_int(v) -> int:
+    """Host-side: one limb vector -> Python int."""
+    v = np.asarray(v, dtype=np.uint64)
+    return sum(int(v[i]) << (B * i) for i in range(L))
+
+
+P_LIMBS = jnp.asarray(int_to_limbs(P), dtype=DTYPE)
+R2_LIMBS = jnp.asarray(int_to_limbs(R2_INT), dtype=DTYPE)
+NPRIME_LIMBS = jnp.asarray(int_to_limbs(NPRIME_INT), dtype=DTYPE)
+ZERO = jnp.zeros((L,), dtype=DTYPE)
+ONE_MONT = jnp.asarray(int_to_limbs(R_MONT % P), dtype=DTYPE)   # 1 in Montgomery form
+
+# Toeplitz index/mask for column products: COL_IDX[k, i] = k - i (clamped),
+# COL_MASK[k, i] = 1 iff 0 <= k - i < L.
+_k = np.arange(NCOLS)[:, None]
+_i = np.arange(L)[None, :]
+COL_IDX = jnp.asarray(np.clip(_k - _i, 0, L - 1), dtype=jnp.int32)
+COL_MASK = jnp.asarray(((_k - _i >= 0) & (_k - _i < L)).astype(np.uint64), dtype=DTYPE)
+
+
+def ints_to_mont(xs) -> jnp.ndarray:
+    """Host-side staging: iterable of Python ints -> (n, L) Montgomery limbs."""
+    arr = np.stack([int_to_limbs(x * R_MONT % P) for x in xs])
+    return jnp.asarray(arr, dtype=DTYPE)
+
+
+def mont_to_ints(v) -> list:
+    """Host-side: (..., L) Montgomery limbs -> flat list of Python ints."""
+    arr = np.asarray(v, dtype=np.uint64).reshape(-1, L)
+    r_inv = pow(R_MONT, -1, P)
+    return [
+        sum(int(row[i]) << (B * i) for i in range(L)) * r_inv % P for row in arr
+    ]
+
+
+# --- Core column arithmetic ----------------------------------------------------
+
+
+def _mul_cols(a, b):
+    """Schoolbook product as 2L-1 column sums (no carries).
+
+    cols[..., k] = sum_{i+j=k} a_i b_j, computed as a Toeplitz gather of b
+    against a — constant HLO op count, fully vectorized over the batch."""
+    tb = b[..., COL_IDX] * COL_MASK          # (..., NCOLS, L)
+    return jnp.sum(tb * a[..., None, :], axis=-1)
+
+
+def _carry(cols, n_out: int):
+    """Propagate carries (lax.scan over columns). Returns (limbs, carry_out).
+
+    cols: (..., n_cols) uint64 column sums; limbs: (..., n_out)."""
+    n_cols = cols.shape[-1]
+    if n_out > n_cols:
+        pad = jnp.zeros(cols.shape[:-1] + (n_out - n_cols,), dtype=cols.dtype)
+        cols = jnp.concatenate([cols, pad], axis=-1)
+    cols_t = jnp.moveaxis(cols[..., :n_out], -1, 0)   # (n_out, ...)
+
+    def step(c, col):
+        tot = col + c
+        return tot >> B, tot & MASK
+
+    carry_out, limbs_t = jax.lax.scan(step, jnp.zeros_like(cols_t[0]), cols_t)
+    return jnp.moveaxis(limbs_t, 0, -1), carry_out
+
+
+def _sub_with_borrow(a, b):
+    """a - b limbwise. Returns (diff limbs, borrow_out in {0,1})."""
+    a_t = jnp.moveaxis(a, -1, 0)
+    b_t = jnp.moveaxis(b, -1, 0)
+
+    def step(borrow, ab):
+        ai, bi = ab
+        tmp = ai + jnp.uint64(1 << B) - bi - borrow
+        return jnp.uint64(1) - (tmp >> B), tmp & MASK
+
+    borrow_out, limbs_t = jax.lax.scan(step, jnp.zeros_like(a_t[0]), (a_t, b_t))
+    return jnp.moveaxis(limbs_t, 0, -1), borrow_out
+
+
+def _cond_sub_p(v):
+    """v - P if v >= P else v (requires v < 2P, normalized limbs)."""
+    diff, borrow = _sub_with_borrow(v, jnp.broadcast_to(P_LIMBS, v.shape))
+    return jnp.where((borrow == 0)[..., None], diff, v)
+
+
+# --- Field ops (Montgomery domain) ---------------------------------------------
+
+
+def add(a, b):
+    s, _ = _carry(a + b, L)
+    return _cond_sub_p(s)
+
+
+def sub(a, b):
+    diff, borrow = _sub_with_borrow(a, b)
+    corr, _ = _carry(
+        diff + jnp.where((borrow == 1)[..., None], jnp.broadcast_to(P_LIMBS, diff.shape), jnp.uint64(0)),
+        L,
+    )
+    return corr
+
+
+def neg(a):
+    """-a mod p (maps 0 to 0)."""
+    is_zero_m = jnp.all(a == 0, axis=-1, keepdims=True)
+    diff, _ = _sub_with_borrow(jnp.broadcast_to(P_LIMBS, a.shape), a)
+    return jnp.where(is_zero_m, a, diff)
+
+
+def mont_mul(a, b):
+    """Montgomery multiplication: a*b*R^-1 mod p (inputs/outputs < p)."""
+    t_cols = _mul_cols(a, b)                                   # (..., 29)
+    t_lo, c_lo = _carry(t_cols[..., :L], L)                    # normalize low half
+    m_cols = _mul_cols(t_lo, jnp.broadcast_to(NPRIME_LIMBS, t_lo.shape))
+    m, _ = _carry(m_cols[..., :L], L)                          # m = T*N' mod R
+    mn_cols = _mul_cols(m, jnp.broadcast_to(P_LIMBS, m.shape))
+    hi_pad = jnp.concatenate(
+        [c_lo[..., None], jnp.zeros(c_lo.shape + (NCOLS - L - 1,), dtype=DTYPE)], axis=-1
+    )
+    s_cols = jnp.concatenate(
+        [t_lo + mn_cols[..., :L], t_cols[..., L:] + mn_cols[..., L:] + hi_pad], axis=-1
+    )
+    all_limbs, c_out = _carry(s_cols, 2 * L)
+    hi = jnp.concatenate([all_limbs[..., L:], c_out[..., None]], axis=-1)[..., :L]
+    return _cond_sub_p(hi)
+
+
+def mont_sqr(a):
+    return mont_mul(a, a)
+
+
+def to_mont(a_std):
+    return mont_mul(a_std, jnp.broadcast_to(R2_LIMBS, a_std.shape))
+
+
+def from_mont(a_mont):
+    one = jnp.zeros_like(a_mont).at[..., 0].set(1)
+    return mont_mul(a_mont, one)
+
+
+def is_zero(a):
+    return jnp.all(a == 0, axis=-1)
+
+
+def eq(a, b):
+    return jnp.all(a == b, axis=-1)
+
+
+def select(mask, a, b):
+    """mask (...) bool -> limbwise select."""
+    return jnp.where(mask[..., None], a, b)
+
+
+def pow_fixed(a, exponent: int):
+    """a^exponent for a fixed (compile-time) exponent via an MSB-first bit
+    loop. Batched over leading axes."""
+    if exponent == 0:
+        return jnp.broadcast_to(ONE_MONT, a.shape)
+    bits = jnp.asarray([int(c) for c in bin(exponent)[2:]], dtype=jnp.uint64)
+
+    def body(i, acc):
+        acc = mont_sqr(acc)
+        return jnp.where(bits[i] == 1, mont_mul(acc, a), acc)
+
+    return jax.lax.fori_loop(1, bits.shape[0], body, a)
+
+
+def inv(a):
+    """a^-1 via Fermat (fixed exponent p-2). Montgomery in, Montgomery out."""
+    return pow_fixed(a, P - 2)

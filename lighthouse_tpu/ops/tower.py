@@ -1,0 +1,325 @@
+"""Extension-field towers over the limb layer (JAX, batched).
+
+Shapes (Montgomery-domain uint64 limbs, trailing axis = L limbs):
+    Fp2  : (..., 2, L)        a0 + a1*u
+    Fp6  : (..., 3, 2, L)     a0 + a1*v + a2*v^2,  v^3 = xi = 1+u
+    Fp12 : (..., 2, 3, 2, L)  a0 + a1*w,           w^2 = v
+
+Compile-size discipline (the pairing traces thousands of these): every tower
+level performs exactly ONE multiplication call into the level below, on a
+stacked batch axis — Karatsuba's independent products ride the batch
+dimension, so an Fp12 multiply bottoms out in a single mont_mul over 54
+stacked Fp elements. Addition/subtraction chains are shape-polymorphic limb
+ops applied to whole towers at once.
+
+Tower layout matches the oracle (lighthouse_tpu.crypto.bls.fields) — the
+differential-test ground truth. Frobenius/sqrt constants are computed at
+import from the oracle, not memorized.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.bls import fields as _of
+from lighthouse_tpu.crypto.bls.constants import P
+
+from . import limbs as lb
+
+# Whole-tower linear ops: limb functions are shape-polymorphic over any
+# (..., L) layout, so adds/subs/selects work on Fp2/Fp6/Fp12 tensors directly.
+add = lb.add
+sub = lb.sub
+neg = lb.neg
+
+
+def _st(*parts):
+    return jnp.stack(parts, axis=-2)
+
+
+# ---------------------------------------------------------------------------
+# Fp2
+# ---------------------------------------------------------------------------
+
+FP2_ZERO = jnp.zeros((2, lb.L), dtype=lb.DTYPE)
+FP2_ONE = jnp.stack([lb.ONE_MONT, jnp.zeros((lb.L,), dtype=lb.DTYPE)])
+
+
+def fp2_from_int_pair(pairs) -> jnp.ndarray:
+    """Host staging: [(c0, c1), ...] ints -> (n, 2, L) Montgomery limbs."""
+    flat = []
+    for c0, c1 in pairs:
+        flat.extend([c0, c1])
+    return lb.ints_to_mont(flat).reshape(-1, 2, lb.L)
+
+
+def fp2_to_int_pairs(a):
+    vals = lb.mont_to_ints(a.reshape(-1, lb.L))
+    return [(vals[i], vals[i + 1]) for i in range(0, len(vals), 2)]
+
+
+def _fp2_const(pair):
+    return fp2_from_int_pair([pair])[0]
+
+
+def fp2_mul(a, b):
+    """Karatsuba: one batched mont_mul of [a0*b0, a1*b1, (a0+a1)(b0+b1)]."""
+    a, b = jnp.broadcast_arrays(a, b)
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    b0, b1 = b[..., 0, :], b[..., 1, :]
+    pre = lb.add(_st(a0, b0), _st(a1, b1))
+    prod = lb.mont_mul(_st(a0, a1, pre[..., 0, :]), _st(b0, b1, pre[..., 1, :]))
+    t0, t1, t2 = prod[..., 0, :], prod[..., 1, :], prod[..., 2, :]
+    return _st(lb.sub(t0, t1), lb.sub(t2, lb.add(t0, t1)))
+
+
+def fp2_sqr(a):
+    """(a0+a1)(a0-a1) and a0*a1 in one batched mont_mul."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    s = lb.add(a0, a1)
+    d = lb.sub(a0, a1)
+    prod = lb.mont_mul(_st(s, a0), _st(d, a1))
+    c0 = prod[..., 0, :]
+    t = prod[..., 1, :]
+    return _st(c0, lb.add(t, t))
+
+
+def fp2_conj(a):
+    return _st(a[..., 0, :], lb.neg(a[..., 1, :]))
+
+
+def fp2_mul_by_xi(a):
+    """(a0 + a1 u)(1 + u) = (a0 - a1) + (a0 + a1) u."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    return lb.add(_st(a0, a0), _st(lb.neg(a1), a1))
+
+
+def fp2_mul_fp(a, s):
+    """Multiply Fp2 by an Fp element (limb vector broadcast over the 2-axis)."""
+    return lb.mont_mul(a, s[..., None, :])
+
+
+def fp2_inv(a):
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    sq = lb.mont_mul(_st(a0, a1), _st(a0, a1))
+    norm = lb.add(sq[..., 0, :], sq[..., 1, :])
+    ninv = lb.inv(norm)
+    return lb.mont_mul(_st(a0, lb.neg(a1)), ninv[..., None, :])
+
+
+def fp2_is_zero(a):
+    return jnp.all(a == 0, axis=(-1, -2))
+
+
+def fp2_eq(a, b):
+    return jnp.all(a == b, axis=(-1, -2))
+
+
+def fp2_select(mask, a, b):
+    return jnp.where(mask[..., None, None], a, b)
+
+
+def fp2_pow_fixed(a, exponent: int):
+    bits = jnp.asarray([int(c) for c in bin(exponent)[2:]], dtype=jnp.uint64)
+
+    def body(i, acc):
+        acc = fp2_sqr(acc)
+        return jnp.where(bits[i] == 1, fp2_mul(acc, a), acc)
+
+    return jax.lax.fori_loop(1, bits.shape[0], body, a)
+
+
+# sqrt in Fp2: candidate c = a^((p^2+7)/16), then multiply by the 4th-root
+# multiplier whose square matches; multiplier squares cover {1,-1,i,-i} via a
+# primitive 8th root of unity w = xi^((p^2-1)/8).
+_SQRT_EXP = (P * P + 7) // 16
+_OMEGA8 = _of.fp2_pow((1, 1), (P * P - 1) // 8)
+_SQRT_MULTS = jnp.stack(
+    [
+        _fp2_const((1, 0)),
+        _fp2_const((0, 1)),
+        _fp2_const(_OMEGA8),
+        _fp2_const(_of.fp2_mul(_OMEGA8, (0, 1))),
+    ]
+)
+
+
+def fp2_sqrt(a):
+    """Returns (root, ok_mask). Either root of a; callers fix the sign."""
+    cand = fp2_pow_fixed(a, _SQRT_EXP)
+    # Try all four multipliers in one batched square: (..., 4, 2, L)
+    shape4 = cand.shape[:-2] + (4, 2, lb.L)
+    attempts = fp2_mul(
+        jnp.broadcast_to(cand[..., None, :, :], shape4),
+        jnp.broadcast_to(_SQRT_MULTS, shape4),
+    )
+    good = fp2_eq(fp2_sqr(attempts), a[..., None, :, :])        # (..., 4)
+    ok = jnp.any(good, axis=-1)
+    idx = jnp.argmax(good, axis=-1)
+    root = jnp.take_along_axis(attempts, idx[..., None, None, None], axis=-3)[..., 0, :, :]
+    return root, ok
+
+
+def fp2_legendre_is_square(a):
+    """a^((p^2-1)/2) != -1 (zero counts as square)."""
+    t = fp2_pow_fixed(a, (P * P - 1) // 2)
+    minus_one = _st(lb.neg(lb.ONE_MONT), jnp.zeros_like(lb.ONE_MONT))
+    return jnp.logical_not(fp2_eq(t, jnp.broadcast_to(minus_one, t.shape)))
+
+
+# ---------------------------------------------------------------------------
+# Fp6
+# ---------------------------------------------------------------------------
+
+FP6_ZERO = jnp.zeros((3, 2, lb.L), dtype=lb.DTYPE)
+FP6_ONE = jnp.concatenate([FP2_ONE[None], jnp.zeros((2, 2, lb.L), dtype=lb.DTYPE)])
+
+
+def _st6(*parts):
+    return jnp.stack(parts, axis=-3)
+
+
+def fp6_mul(a, b):
+    """Toom/Karatsuba: ONE batched fp2_mul over 6 stacked products."""
+    a, b = jnp.broadcast_arrays(a, b)
+    a0, a1, a2 = a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
+    b0, b1, b2 = b[..., 0, :, :], b[..., 1, :, :], b[..., 2, :, :]
+    pre = lb.add(
+        jnp.stack([a1, b1, a0, b0, a0, b0], axis=-3),
+        jnp.stack([a2, b2, a1, b1, a2, b2], axis=-3),
+    )
+    s12a, s12b = pre[..., 0, :, :], pre[..., 1, :, :]
+    s01a, s01b = pre[..., 2, :, :], pre[..., 3, :, :]
+    s02a, s02b = pre[..., 4, :, :], pre[..., 5, :, :]
+    prod = fp2_mul(
+        jnp.stack([a0, a1, a2, s12a, s01a, s02a], axis=-3),
+        jnp.stack([b0, b1, b2, s12b, s01b, s02b], axis=-3),
+    )
+    t0, t1, t2 = prod[..., 0, :, :], prod[..., 1, :, :], prod[..., 2, :, :]
+    u12, u01, u02 = prod[..., 3, :, :], prod[..., 4, :, :], prod[..., 5, :, :]
+    c0 = lb.add(t0, fp2_mul_by_xi(lb.sub(u12, lb.add(t1, t2))))
+    c1 = lb.add(lb.sub(u01, lb.add(t0, t1)), fp2_mul_by_xi(t2))
+    c2 = lb.add(lb.sub(u02, lb.add(t0, t2)), t1)
+    return _st6(c0, c1, c2)
+
+
+def fp6_sqr(a):
+    return fp6_mul(a, a)
+
+
+def fp6_mul_by_v(a):
+    return _st6(fp2_mul_by_xi(a[..., 2, :, :]), a[..., 0, :, :], a[..., 1, :, :])
+
+
+def fp6_inv(a):
+    a0, a1, a2 = a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
+    sq = fp2_sqr(_st6(a0, a2, a1))
+    p1 = fp2_mul(_st6(a1, a0, a0), _st6(a2, a1, a2))
+    c0 = sub(sq[..., 0, :, :], fp2_mul_by_xi(p1[..., 0, :, :]))
+    c1 = sub(fp2_mul_by_xi(sq[..., 1, :, :]), p1[..., 1, :, :])
+    c2 = sub(sq[..., 2, :, :], p1[..., 2, :, :])
+    tp = fp2_mul(_st6(a2, a1, a0), _st6(c1, c2, c0))
+    t = add(fp2_mul_by_xi(add(tp[..., 0, :, :], tp[..., 1, :, :])), tp[..., 2, :, :])
+    tinv = fp2_inv(t)
+    return fp2_mul(_st6(c0, c1, c2), tinv[..., None, :, :])
+
+
+# ---------------------------------------------------------------------------
+# Fp12
+# ---------------------------------------------------------------------------
+
+FP12_ZERO = jnp.zeros((2, 3, 2, lb.L), dtype=lb.DTYPE)
+FP12_ONE = jnp.concatenate([FP6_ONE[None], jnp.zeros((1, 3, 2, lb.L), dtype=lb.DTYPE)])
+
+
+def _st12(c0, c1):
+    return jnp.stack([c0, c1], axis=-4)
+
+
+def fp12_mul(a, b):
+    """Karatsuba: ONE batched fp6_mul over 3 stacked products."""
+    a, b = jnp.broadcast_arrays(a, b)
+    a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
+    b0, b1 = b[..., 0, :, :, :], b[..., 1, :, :, :]
+    pre = lb.add(jnp.stack([a0, b0], axis=-4), jnp.stack([a1, b1], axis=-4))
+    prod = fp6_mul(
+        jnp.stack([a0, a1, pre[..., 0, :, :, :]], axis=-4),
+        jnp.stack([b0, b1, pre[..., 1, :, :, :]], axis=-4),
+    )
+    t0, t1, t2 = prod[..., 0, :, :, :], prod[..., 1, :, :, :], prod[..., 2, :, :, :]
+    c0 = add(t0, fp6_mul_by_v(t1))
+    c1 = sub(t2, add(t0, t1))
+    return _st12(c0, c1)
+
+
+def fp12_sqr(a):
+    return fp12_mul(a, a)
+
+
+def fp12_conj(a):
+    return _st12(a[..., 0, :, :, :], neg(a[..., 1, :, :, :]))
+
+
+def fp12_inv(a):
+    a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
+    sq = fp6_sqr(jnp.stack([a0, a1], axis=-4))
+    t = sub(sq[..., 0, :, :, :], fp6_mul_by_v(sq[..., 1, :, :, :]))
+    tinv = fp6_inv(t)
+    res = fp6_mul(
+        jnp.stack([a0, neg(a1)], axis=-4),
+        jnp.broadcast_to(tinv[..., None, :, :, :], a.shape),
+    )
+    return _st12(res[..., 0, :, :, :], res[..., 1, :, :, :])
+
+
+def fp12_eq(a, b):
+    return jnp.all(a == b, axis=(-1, -2, -3, -4))
+
+
+def fp12_is_one(a):
+    return fp12_eq(a, jnp.broadcast_to(FP12_ONE, a.shape))
+
+
+# Frobenius: conjugate each Fp2 coefficient, multiply by gamma constants
+# (gamma1[j] = xi^(j (p-1)/6), from the oracle at import).
+_GAMMA1_CONSTS = jnp.stack([_fp2_const(_of._GAMMA1[j]) for j in range(6)])
+# Layout the six gammas as an Fp12-shaped multiplier (w^j for coefficient j):
+# c0 coefficients are w^0, w^2, w^4; c1 are w^1, w^3, w^5.
+_FROB_MULT = jnp.stack(
+    [
+        jnp.stack([_GAMMA1_CONSTS[0], _GAMMA1_CONSTS[2], _GAMMA1_CONSTS[4]]),
+        jnp.stack([_GAMMA1_CONSTS[1], _GAMMA1_CONSTS[3], _GAMMA1_CONSTS[5]]),
+    ]
+)
+
+
+def fp12_frob(a):
+    """a -> a^p: conjugate all 6 Fp2 coefficients, multiply by gamma(w^j)."""
+    conj = jnp.concatenate(
+        [a[..., 0:1, :], lb.neg(a[..., 1:2, :])], axis=-2
+    )  # fp2-conj across the whole tower
+    return fp2_mul(conj, jnp.broadcast_to(_FROB_MULT, a.shape))
+
+
+def fp12_frob_n(a, n: int):
+    for _ in range(n % 12):
+        a = fp12_frob(a)
+    return a
+
+
+# Host staging helpers -----------------------------------------------------
+
+
+def fp12_from_oracle(x) -> jnp.ndarray:
+    flat = []
+    for c6 in x:
+        for c2 in c6:
+            flat.extend([c2[0], c2[1]])
+    return lb.ints_to_mont(flat).reshape(2, 3, 2, lb.L)
+
+
+def fp12_to_oracle(a):
+    vals = lb.mont_to_ints(a.reshape(-1, lb.L))
+    it = iter(vals)
+    return tuple(tuple((next(it), next(it)) for _ in range(3)) for _ in range(2))
