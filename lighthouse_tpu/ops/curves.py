@@ -1,0 +1,382 @@
+"""G1/G2 group operations for BLS12-381 (JAX, batched, branch-free).
+
+TPU-first design: points are homogeneous projective ``(X, Y, Z)`` (affine
+x = X/Z; infinity = (0, 1, 0)) and all arithmetic uses the Renes–Costello–
+Batina *complete* addition/doubling formulas for a = 0 curves. Complete
+formulas are exception-free on the entire curve group — no special cases for
+infinity/doubling — which removes every data-dependent branch from the group
+law and lets one ``lax.scan`` body serve every element of a batch. (The
+reference's blst backend branches per point; SURVEY.md §2.7 item 1.)
+
+Shapes (Montgomery limbs, trailing axis L):
+    G1 point: (..., 3, L)        coordinates in Fp
+    G2 point: (..., 3, 2, L)     coordinates in Fp2 (twist curve y^2 = x^3 + 4(1+u))
+
+Per group-op cost: exactly TWO batched Montgomery multiplications (the
+independent field products of each RCB group ride a stacked axis), so a
+64-bit scalar multiplication lowers to a 64-iteration scan of ~8 mont_muls.
+
+Differentially tested against the pure-Python oracle
+(lighthouse_tpu.crypto.bls.curves). Reference semantics being replaced:
+crypto/bls/src/impls/blst.rs:72-135 (subgroup checks), generic_public_key.rs
+(infinity rejection).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.bls import curves as _oc
+from lighthouse_tpu.crypto.bls import fields as _of
+from lighthouse_tpu.crypto.bls.constants import BLS_X_ABS, R
+
+from . import limbs as lb
+from . import tower as tw
+
+
+# ---------------------------------------------------------------------------
+# Field adapters: the group law is written once against this interface.
+# ---------------------------------------------------------------------------
+
+class _FieldAdapter:
+    """Element-wise batched field ops + a stacked multi-multiply.
+
+    ``mul_many([a...],[b...])`` stacks the independent products of one RCB
+    group on a new axis and performs ONE multiplication call — the trick that
+    keeps the traced graph small and the TPU busy."""
+
+    def __init__(self, tail_ndim, add, sub, neg, mul, is_zero, zero, one):
+        self.tail_ndim = tail_ndim      # dims of one field element (Fp: 1, Fp2: 2)
+        self.add = add
+        self.sub = sub
+        self.neg = neg
+        self.mul = mul
+        self.is_zero = is_zero
+        self.zero = zero
+        self.one = one
+
+    def mul_many(self, xs, ys):
+        axis = -(self.tail_ndim + 1)
+        prod = self.mul(jnp.stack(xs, axis=axis), jnp.stack(ys, axis=axis))
+        return [jnp.take(prod, i, axis=axis) for i in range(len(xs))]
+
+    def mul_small(self, a, k: int):
+        """Multiply by a small positive int via a double-and-add chain of
+        reduced additions (keeps every intermediate < p)."""
+        acc = None
+        dbl = a
+        while k:
+            if k & 1:
+                acc = dbl if acc is None else self.add(acc, dbl)
+            k >>= 1
+            if k:
+                dbl = self.add(dbl, dbl)
+        return acc
+
+
+FP = _FieldAdapter(
+    tail_ndim=1,
+    add=lb.add, sub=lb.sub, neg=lb.neg, mul=lb.mont_mul,
+    is_zero=lb.is_zero, zero=lb.ZERO, one=lb.ONE_MONT,
+)
+
+FP2 = _FieldAdapter(
+    tail_ndim=2,
+    add=lb.add, sub=lb.sub, neg=lb.neg, mul=tw.fp2_mul,
+    is_zero=tw.fp2_is_zero, zero=tw.FP2_ZERO, one=tw.FP2_ONE,
+)
+
+
+class _Group:
+    """One elliptic-curve group (E1/Fp or E2'/Fp2 twist) with b3 = 3b."""
+
+    def __init__(self, field: _FieldAdapter, b_mul, b3_mul, name: str):
+        self.f = field
+        self.b_mul = b_mul              # x -> b*x (for the curve equation)
+        self.b3_mul = b3_mul            # x -> 3*b*x (cheap, structure-specific)
+        self.name = name
+        self.infinity = jnp.stack([field.zero, field.one, field.zero], axis=0)
+
+    # -- point plumbing ----------------------------------------------------
+
+    def coords(self, p):
+        ax = -(self.f.tail_ndim + 1)
+        return (jnp.take(p, 0, axis=ax), jnp.take(p, 1, axis=ax), jnp.take(p, 2, axis=ax))
+
+    def pack(self, X, Y, Z):
+        return jnp.stack([X, Y, Z], axis=-(self.f.tail_ndim + 1))
+
+    def is_infinity(self, p):
+        _, _, Z = self.coords(p)
+        return self.f.is_zero(Z)
+
+    def on_curve(self, p):
+        """Projective curve equation Y^2 Z == X^3 + b Z^3 (infinity passes).
+
+        The complete formulas (and hence the subgroup checks) are only
+        exception-free for genuine curve points; callers staging untrusted
+        coordinates must gate on this, matching the oracle's behavior
+        (crypto/bls/curves.py g{1,2}_in_subgroup on-curve precondition)."""
+        f = self.f
+        X, Y, Z = self.coords(p)
+        y2, x2, z2 = f.mul_many([Y, X, Z], [Y, X, Z])
+        y2z, x3, z3 = f.mul_many([y2, x2, z2], [Z, X, Z])
+        return f.is_zero(f.sub(y2z, f.add(x3, self.b_mul(z3))))
+
+    def select(self, mask, a, b):
+        """Pointwise select with mask shaped like the batch prefix."""
+        return jnp.where(mask[(...,) + (None,) * (self.f.tail_ndim + 1)], a, b)
+
+    # -- complete group law (Renes–Costello–Batina 2016, a = 0) ------------
+
+    def add(self, p, q):
+        """Complete addition, exception-free for ALL curve points (incl.
+        infinity and p == q). Two batched field multiplications."""
+        f = self.f
+        X1, Y1, Z1 = self.coords(p)
+        X2, Y2, Z2 = self.coords(q)
+        t0, t1, t2, m3, m4, m5 = f.mul_many(
+            [X1, Y1, Z1, f.add(X1, Y1), f.add(Y1, Z1), f.add(X1, Z1)],
+            [X2, Y2, Z2, f.add(X2, Y2), f.add(Y2, Z2), f.add(X2, Z2)],
+        )
+        t3 = f.sub(m3, f.add(t0, t1))          # X1Y2 + X2Y1
+        t4 = f.sub(m4, f.add(t1, t2))          # Y1Z2 + Y2Z1
+        ty = f.sub(m5, f.add(t0, t2))          # X1Z2 + X2Z1
+        t03 = f.mul_small(t0, 3)
+        t2b = self.b3_mul(t2)
+        z3s = f.add(t1, t2b)
+        t1b = f.sub(t1, t2b)
+        yb = self.b3_mul(ty)
+        p0, p1, p2, p3, p4, p5 = f.mul_many(
+            [t4, t3, yb, t1b, t03, z3s],
+            [yb, t1b, t03, z3s, t3, t4],
+        )
+        return self.pack(f.sub(p1, p0), f.add(p2, p3), f.add(p5, p4))
+
+    def double(self, p):
+        """Complete doubling (RCB alg. 9, a = 0). Two batched field muls."""
+        f = self.f
+        X, Y, Z = self.coords(p)
+        t0, t1, t2, txy = f.mul_many([Y, Y, Z, X], [Y, Z, Z, Y])
+        t2b = self.b3_mul(t2)
+        z8 = f.mul_small(t0, 8)
+        y3s = f.add(t0, t2b)
+        t0p = f.sub(t0, f.mul_small(t2b, 3))
+        q0, q1, q2, q3 = f.mul_many([t2b, t1, t0p, t0p], [z8, z8, y3s, txy])
+        return self.pack(f.add(q3, q3), f.add(q0, q2), q1)
+
+    def neg(self, p):
+        X, Y, Z = self.coords(p)
+        return self.pack(X, self.f.neg(Y), Z)
+
+    def eq(self, p, q):
+        """Projective equality: cross-multiplied, infinity-aware."""
+        f = self.f
+        X1, Y1, Z1 = self.coords(p)
+        X2, Y2, Z2 = self.coords(q)
+        a0, a1, b0, b1 = f.mul_many([X1, Y1, X2, Y2], [Z2, Z2, Z1, Z1])
+        both_inf = jnp.logical_and(f.is_zero(Z1), f.is_zero(Z2))
+        one_inf = jnp.logical_xor(f.is_zero(Z1), f.is_zero(Z2))
+        same = jnp.logical_and(
+            jnp.all(a0 == b0, axis=tuple(range(-f.tail_ndim, 0))),
+            jnp.all(a1 == b1, axis=tuple(range(-f.tail_ndim, 0))),
+        )
+        return jnp.logical_or(both_inf, jnp.logical_and(~one_inf, same))
+
+    # -- scalar multiplication ---------------------------------------------
+
+    def mul_fixed_scalar(self, p, k: int):
+        """[k]p for a compile-time scalar, MSB-first double-and-add via scan
+        (one traced body regardless of bit length)."""
+        if k < 0:
+            return self.mul_fixed_scalar(self.neg(p), -k)
+        if k == 0:
+            return jnp.broadcast_to(self.infinity, p.shape)
+        bits = jnp.asarray([int(c) for c in bin(k)[2:]], dtype=jnp.uint8)
+
+        def step(acc, bit):
+            acc = self.double(acc)
+            with_add = self.add(acc, p)
+            cond = jnp.broadcast_to(bit == 1, acc.shape[: acc.ndim - self.f.tail_ndim - 1])
+            return self.select(cond, with_add, acc), None
+
+        init = jnp.broadcast_to(self.infinity, p.shape)
+        acc, _ = jax.lax.scan(step, init, bits)
+        return acc
+
+    def mul_var_scalar(self, p, k, nbits: int = 64):
+        """[k]p with a per-element scalar array (batched, e.g. the random
+        64-bit batch-verification coefficients). ``k``: uint64, shape = batch
+        prefix of ``p``. MSB-first scan over ``nbits`` positions."""
+        positions = jnp.arange(nbits - 1, -1, -1, dtype=jnp.uint64)
+
+        def step(acc, pos):
+            acc = self.double(acc)
+            bit = (k >> pos) & jnp.uint64(1)
+            with_add = self.add(acc, p)
+            return self.select(bit == 1, with_add, acc), None
+
+        init = jnp.broadcast_to(self.infinity, p.shape)
+        acc, _ = jax.lax.scan(step, init, positions)
+        return acc
+
+    def msm_reduce(self, pts, axis_size: int):
+        """Sum a batch of points along the leading axis by binary tree
+        reduction (log2 depth of complete adds)."""
+        n = 1
+        while n < axis_size:
+            n *= 2
+        if n != axis_size:
+            pad = jnp.broadcast_to(self.infinity, (n - axis_size,) + pts.shape[1:])
+            pts = jnp.concatenate([pts, pad], axis=0)
+        while n > 1:
+            half = n // 2
+            pts = self.add(pts[:half], pts[half:])
+            n = half
+        return pts[0]
+
+
+def _b_g1(a):
+    """b1 = 4 (E1: y^2 = x^3 + 4)."""
+    return FP.mul_small(a, 4)
+
+
+def _b3_g1(a):
+    """3*b1 = 12."""
+    return FP.mul_small(a, 12)
+
+
+def _b_g2(a):
+    """b2 = 4*(1+u) = 4*xi (twist E2': y^2 = x^3 + 4(1+u))."""
+    return FP2.mul_small(tw.fp2_mul_by_xi(a), 4)
+
+
+def _b3_g2(a):
+    """3*b2 = 12*xi."""
+    return FP2.mul_small(tw.fp2_mul_by_xi(a), 12)
+
+
+G1 = _Group(FP, _b_g1, _b3_g1, "G1")
+G2 = _Group(FP2, _b_g2, _b3_g2, "G2")
+
+
+# ---------------------------------------------------------------------------
+# Host staging (oracle affine <-> device projective)
+# ---------------------------------------------------------------------------
+
+def g1_from_affine(pts) -> jnp.ndarray:
+    """[(x, y) | None, ...] oracle points -> (n, 3, L) device points."""
+    flat = []
+    for pt in pts:
+        if pt is None:
+            flat.extend([0, 1, 0])
+        else:
+            flat.extend([pt[0], pt[1], 1])
+    return lb.ints_to_mont(flat).reshape(-1, 3, lb.L)
+
+
+def g1_to_affine(dev):
+    """(n, 3, L) device points -> [(x, y) | None, ...] (host, via oracle inv)."""
+    vals = lb.mont_to_ints(np.asarray(dev).reshape(-1, lb.L))
+    out = []
+    for i in range(0, len(vals), 3):
+        X, Y, Z = vals[i], vals[i + 1], vals[i + 2]
+        if Z == 0:
+            out.append(None)
+        else:
+            zi = _of.fp_inv(Z)
+            out.append((X * zi % _of.P, Y * zi % _of.P))
+    return out
+
+
+def g2_from_affine(pts) -> jnp.ndarray:
+    """[( (x0,x1), (y0,y1) ) | None, ...] -> (n, 3, 2, L) device points."""
+    flat = []
+    for pt in pts:
+        if pt is None:
+            flat.extend([0, 0, 1, 0, 0, 0])
+        else:
+            (x0, x1), (y0, y1) = pt
+            flat.extend([x0, x1, y0, y1, 1, 0])
+    return lb.ints_to_mont(flat).reshape(-1, 3, 2, lb.L)
+
+
+def g2_to_affine(dev):
+    vals = lb.mont_to_ints(np.asarray(dev).reshape(-1, lb.L))
+    out = []
+    for i in range(0, len(vals), 6):
+        X = (vals[i], vals[i + 1])
+        Y = (vals[i + 2], vals[i + 3])
+        Z = (vals[i + 4], vals[i + 5])
+        if Z == (0, 0):
+            out.append(None)
+        else:
+            zi = _of.fp2_inv(Z)
+            out.append((_of.fp2_mul(X, zi), _of.fp2_mul(Y, zi)))
+    return out
+
+
+G1_GEN = g1_from_affine([_oc.G1_GEN])[0]
+G2_GEN = g2_from_affine([_oc.G2_GEN])[0]
+
+
+# ---------------------------------------------------------------------------
+# psi endomorphism & subgroup checks (G2), cofactor clearing
+# ---------------------------------------------------------------------------
+
+# psi(x, y) = (c_x * conj(x), c_y * conj(y)) — constants from the oracle
+# derivation (untwist-Frobenius-twist; curves.py:218-219 of the oracle).
+_PSI_CX = tw.fp2_from_int_pair([_oc.PSI_CX])[0]
+_PSI_CY = tw.fp2_from_int_pair([_oc.PSI_CY])[0]
+
+
+def g2_psi(p):
+    """psi in projective coordinates: (c_x conj(X), c_y conj(Y), conj(Z))."""
+    X, Y, Z = G2.coords(p)
+    cx, cy = jnp.broadcast_arrays(_PSI_CX, X)[0], jnp.broadcast_arrays(_PSI_CY, Y)[0]
+    prod = tw.fp2_mul(
+        jnp.stack([tw.fp2_conj(X), tw.fp2_conj(Y)], axis=-3),
+        jnp.stack([cx, cy], axis=-3),
+    )
+    return G2.pack(prod[..., 0, :, :], prod[..., 1, :, :], tw.fp2_conj(Z))
+
+
+def g2_in_subgroup(p):
+    """P on E2' and in G2: Bowe's check psi(P) == [x]P, i.e.
+    psi(P) + [|x|]P == O (x negative). Batched; same boolean as blst
+    (impls/blst.rs:72-82), including the on-curve precondition."""
+    s = G2.add(g2_psi(p), G2.mul_fixed_scalar(p, BLS_X_ABS))
+    return jnp.logical_and(G2.on_curve(p), G2.is_infinity(s))
+
+
+def g1_in_subgroup(p):
+    """P on E1 and full-order [r]P == O (used at pubkey-cache fill, not in
+    the hot loop — reference amortizes via validator_pubkey_cache.rs:10-23)."""
+    return jnp.logical_and(
+        G1.on_curve(p), G1.is_infinity(G1.mul_fixed_scalar(p, R))
+    )
+
+
+def g2_mul_by_x_abs(p):
+    """[|x|]P — the 64-bit fixed-scalar workhorse of cofactor clearing."""
+    return G2.mul_fixed_scalar(p, BLS_X_ABS)
+
+
+def g2_clear_cofactor(p):
+    """h_eff * P via the psi decomposition (Budroni–Pintore):
+
+        [x^2 - x - 1]P + [x - 1]psi(P) + psi(psi([2]P))
+
+    with x the (negative) BLS parameter: two 64-bit scalar scans instead of a
+    636-bit one. Cross-validated against the oracle's plain h_eff multiply
+    (RFC 9380 §8.8.2) in tests.
+    """
+    xp = G2.neg(g2_mul_by_x_abs(p))              # [x]P
+    xxp = G2.neg(g2_mul_by_x_abs(xp))            # [x^2]P
+    term1 = G2.add(G2.add(xxp, G2.neg(xp)), G2.neg(p))      # [x^2 - x - 1]P
+    # [x-1]psi(P) = psi([x-1]P): psi is a homomorphism, so reuse xp instead
+    # of paying a third 64-bit scalar scan.
+    term2 = g2_psi(G2.add(xp, G2.neg(p)))
+    term3 = g2_psi(g2_psi(G2.double(p)))
+    return G2.add(G2.add(term1, term2), term3)

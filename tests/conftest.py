@@ -2,13 +2,23 @@
 
 JAX tests run on a virtual 8-device CPU mesh (multi-chip hardware is not
 available in CI; the sharding layer is designed for a real TPU mesh and
-validated here on forced host devices). Must run before jax is imported.
+validated here on forced host devices).
+
+The environment may pre-register a remote TPU platform (axon) via
+sitecustomize and pin JAX_PLATFORMS to it; eager dispatch over that tunnel
+costs seconds per op, so tests force the CPU backend both via the env var
+(before jax import) and the config (after import, which wins over the
+sitecustomize registration).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

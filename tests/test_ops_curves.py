@@ -1,0 +1,169 @@
+"""Differential tests: JAX curve ops (complete projective formulas) vs the
+pure-Python oracle (lighthouse_tpu.crypto.bls.curves)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto.bls import curves as oc
+from lighthouse_tpu.crypto.bls import fields as of
+from lighthouse_tpu.crypto.bls import hash_to_curve as oh2c
+from lighthouse_tpu.crypto.bls.constants import BLS_X_ABS, P, R
+from lighthouse_tpu.ops import curves as dc
+
+rng = random.Random(0xC0FFEE)
+
+
+def rand_g1(n):
+    return [oc.g1_mul(oc.G1_GEN, rng.randrange(1, R)) for _ in range(n)]
+
+
+def rand_g2(n):
+    return [oc.g2_mul(oc.G2_GEN, rng.randrange(1, R)) for _ in range(n)]
+
+
+def curve_point_g2_not_in_subgroup():
+    """An E2 point outside G2: SSWU image before cofactor clearing."""
+    for i in range(20):
+        u = oh2c.hash_to_field_fp2(bytes([i]) * 32, 1)[0]
+        pt = oh2c.iso_map_g2(oh2c.map_to_curve_simple_swu_g2(u))
+        if pt is not None and not oc.g2_in_subgroup(pt):
+            return pt
+    raise AssertionError("could not build non-subgroup G2 point")
+
+
+def curve_point_g1_not_in_subgroup():
+    """An E1 point outside G1 (cofactor h1 is ~2^125, random points miss)."""
+    x = 1
+    while True:
+        y = of.fp_sqrt((x * x * x + 4) % P)
+        if y is not None and not oc.g1_in_subgroup((x, y)):
+            return (x, y)
+        x += 1
+
+
+class TestG1:
+    def test_add_batch(self):
+        pts_a = rand_g1(8) + [None]
+        pts_b = rand_g1(8) + [None]
+        da, db = dc.g1_from_affine(pts_a), dc.g1_from_affine(pts_b)
+        got = dc.g1_to_affine(dc.G1.add(da, db))
+        want = [oc.g1_add(a, b) for a, b in zip(pts_a, pts_b)]
+        assert got == want
+
+    def test_add_special_cases(self):
+        p = rand_g1(1)[0]
+        cases = [
+            (p, p),                    # doubling through add
+            (p, None),                 # P + O
+            (None, p),                 # O + P
+            (None, None),              # O + O
+            (p, oc.g1_neg(p)),         # P + (-P) = O
+        ]
+        da = dc.g1_from_affine([a for a, _ in cases])
+        db = dc.g1_from_affine([b for _, b in cases])
+        got = dc.g1_to_affine(dc.G1.add(da, db))
+        want = [oc.g1_add(a, b) for a, b in cases]
+        assert got == want
+
+    def test_double(self):
+        pts = rand_g1(4) + [None]
+        got = dc.g1_to_affine(dc.G1.double(dc.g1_from_affine(pts)))
+        want = [oc.g1_add(p, p) for p in pts]
+        assert got == want
+
+    def test_fixed_scalar_mul(self):
+        p = rand_g1(1)[0]
+        for k in [1, 2, 3, 5, 0xDEADBEEF, R - 1, R, R + 7]:
+            got = dc.g1_to_affine(dc.G1.mul_fixed_scalar(dc.g1_from_affine([p]), k))[0]
+            assert got == oc.g1_mul(p, k), hex(k)
+
+    def test_var_scalar_mul_batch(self):
+        pts = rand_g1(6)
+        ks = [rng.randrange(1, 1 << 64) for _ in range(6)]
+        dev = dc.G1.mul_var_scalar(
+            dc.g1_from_affine(pts), np.asarray(ks, dtype=np.uint64)
+        )
+        got = dc.g1_to_affine(dev)
+        want = [oc.g1_mul(p, k) for p, k in zip(pts, ks)]
+        assert got == want
+
+    def test_subgroup_check(self):
+        good = rand_g1(2)
+        bad = curve_point_g1_not_in_subgroup()
+        off_curve = (5, 7)  # y^2 != x^3 + 4
+        dev = dc.g1_from_affine(good + [bad, None, off_curve])
+        got = np.asarray(dc.g1_in_subgroup(dev))
+        assert got.tolist() == [True, True, False, True, False]
+
+    def test_msm_reduce(self):
+        for n in (1, 2, 3, 5, 8):
+            pts = rand_g1(n)
+            got = dc.g1_to_affine(dc.G1.msm_reduce(dc.g1_from_affine(pts), n)[None])[0]
+            want = None
+            for p in pts:
+                want = oc.g1_add(want, p)
+            assert got == want
+
+
+class TestG2:
+    def test_add_batch(self):
+        pts_a = rand_g2(4) + [None]
+        pts_b = rand_g2(4) + [None]
+        got = dc.g2_to_affine(dc.G2.add(dc.g2_from_affine(pts_a), dc.g2_from_affine(pts_b)))
+        want = [oc.g2_add(a, b) for a, b in zip(pts_a, pts_b)]
+        assert got == want
+
+    def test_add_special_cases(self):
+        p = rand_g2(1)[0]
+        cases = [(p, p), (p, None), (None, p), (None, None), (p, oc.g2_neg(p))]
+        da = dc.g2_from_affine([a for a, _ in cases])
+        db = dc.g2_from_affine([b for _, b in cases])
+        got = dc.g2_to_affine(dc.G2.add(da, db))
+        want = [oc.g2_add(a, b) for a, b in cases]
+        assert got == want
+
+    def test_fixed_scalar_mul(self):
+        p = rand_g2(1)[0]
+        for k in [1, 2, 0xD201000000010000, R - 1, R]:
+            got = dc.g2_to_affine(dc.G2.mul_fixed_scalar(dc.g2_from_affine([p]), k))[0]
+            assert got == oc.g2_mul(p, k), hex(k)
+
+    def test_var_scalar_mul_batch(self):
+        pts = rand_g2(4)
+        ks = [rng.randrange(1, 1 << 64) for _ in range(4)]
+        dev = dc.G2.mul_var_scalar(dc.g2_from_affine(pts), np.asarray(ks, dtype=np.uint64))
+        assert dc.g2_to_affine(dev) == [oc.g2_mul(p, k) for p, k in zip(pts, ks)]
+
+    def test_psi(self):
+        pts = rand_g2(3)
+        got = dc.g2_to_affine(dc.g2_psi(dc.g2_from_affine(pts)))
+        want = [oc.g2_psi(p) for p in pts]
+        assert got == want
+
+    def test_subgroup_check(self):
+        good = rand_g2(2)
+        bad = curve_point_g2_not_in_subgroup()
+        off_curve = ((5, 6), (7, 8))  # not on E2'
+        dev = dc.g2_from_affine(good + [bad, None, off_curve])
+        got = np.asarray(dc.g2_in_subgroup(dev))
+        assert got.tolist() == [True, True, False, True, False]
+
+    def test_clear_cofactor_matches_oracle_h_eff(self):
+        pts = [curve_point_g2_not_in_subgroup(), rand_g2(1)[0]]
+        got = dc.g2_to_affine(dc.g2_clear_cofactor(dc.g2_from_affine(pts)))
+        want = [oc.g2_clear_cofactor(p) for p in pts]
+        assert got == want
+        # And the result is always in the subgroup.
+        assert oc.g2_in_subgroup(got[0])
+
+    def test_eq(self):
+        p, q = rand_g2(2)
+        # Same point under different projective representations: [2]P vs P+P.
+        dp = dc.g2_from_affine([p, p, None, p])
+        dq = dc.g2_from_affine([p, q, None, None])
+        dbl_a = dc.G2.double(dc.g2_from_affine([p]))
+        dbl_b = dc.G2.add(dc.g2_from_affine([p]), dc.g2_from_affine([p]))
+        assert np.asarray(dc.G2.eq(dp, dq)).tolist() == [True, False, True, False]
+        assert bool(np.asarray(dc.G2.eq(dbl_a, dbl_b))[0])
