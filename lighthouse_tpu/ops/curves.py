@@ -224,17 +224,7 @@ class _Group:
     def msm_reduce(self, pts, axis_size: int):
         """Sum a batch of points along the leading axis by binary tree
         reduction (log2 depth of complete adds)."""
-        n = 1
-        while n < axis_size:
-            n *= 2
-        if n != axis_size:
-            pad = jnp.broadcast_to(self.infinity, (n - axis_size,) + pts.shape[1:])
-            pts = jnp.concatenate([pts, pad], axis=0)
-        while n > 1:
-            half = n // 2
-            pts = self.add(pts[:half], pts[half:])
-            n = half
-        return pts[0]
+        return lb.tree_reduce(pts, self.add, self.infinity, axis_size)
 
 
 def _b_g1(a):

@@ -200,6 +200,23 @@ def select(mask, a, b):
     return jnp.where(mask[..., None], a, b)
 
 
+def tree_reduce(vals, combine, identity, axis_size: int):
+    """Reduce (n, ...) along axis 0 with `combine` in log2 depth, padding to a
+    power of two with `identity` (broadcastable element shape). Serves both
+    point-sum (curves.msm_reduce) and GT-product (pairing) reductions."""
+    n = 1
+    while n < axis_size:
+        n *= 2
+    if n != axis_size:
+        pad = jnp.broadcast_to(identity, (n - axis_size,) + vals.shape[1:])
+        vals = jnp.concatenate([vals, pad], axis=0)
+    while n > 1:
+        half = n // 2
+        vals = combine(vals[:half], vals[half:])
+        n = half
+    return vals[0]
+
+
 def pow_fixed(a, exponent: int):
     """a^exponent for a fixed (compile-time) exponent via an MSB-first bit
     loop. Batched over leading axes."""

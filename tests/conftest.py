@@ -22,3 +22,7 @@ os.environ.setdefault("JAX_ENABLE_X64", "1")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# The env var above is ineffective when sitecustomize imports jax before this
+# file runs; the config update always wins. Same for x64 (uint64 limbs would
+# otherwise be silently truncated to uint32 in any test that skips ops/).
+jax.config.update("jax_enable_x64", True)

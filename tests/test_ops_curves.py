@@ -4,7 +4,6 @@ pure-Python oracle (lighthouse_tpu.crypto.bls.curves)."""
 import random
 
 import numpy as np
-import pytest
 
 from lighthouse_tpu.crypto.bls import curves as oc
 from lighthouse_tpu.crypto.bls import fields as of
