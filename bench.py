@@ -1,0 +1,85 @@
+"""Headline benchmark: batched BLS signature-set verification throughput.
+
+Runs the north-star workload (BASELINE.json config #2 shape): a
+mainnet-attestation-style batch of signature sets through the device backend
+(`lighthouse_tpu.ops.backend.verify_signature_sets_tpu`), and prints ONE JSON
+line:
+
+    {"metric": ..., "value": N, "unit": "sigs/sec", "vs_baseline": N}
+
+`vs_baseline` is measured throughput divided by BLST_CPU_BASELINE — an
+order-of-magnitude estimate of the reference's rayon-parallel blst batch
+verify on a 16-core host (~0.7 ms/set/core; the reference publishes no
+absolute numbers, BASELINE.md). Refine when the C++ comparator lands.
+
+Uses whatever accelerator JAX finds (real TPU under axon; CPU otherwise).
+"""
+
+import json
+import time
+
+BLST_CPU_BASELINE_SIGS_PER_SEC = 20_000.0
+
+# Batch shape: 64 sets (the reference's gossip batch cap,
+# beacon_processor/src/lib.rs:215-216) x 4 aggregated pubkeys per set.
+N_SETS = 64
+KEYS_PER_SET = 4
+TIMED_ITERS = 3
+
+
+def _make_sets():
+    from lighthouse_tpu.crypto.bls.api import (
+        AggregateSignature,
+        SecretKey,
+        Signature,
+        SignatureSet,
+    )
+
+    sets = []
+    for i in range(N_SETS):
+        sks = [SecretKey(100_000 + i * 64 + j) for j in range(KEYS_PER_SET)]
+        msg = i.to_bytes(4, "big") * 8
+        agg = AggregateSignature.aggregate([sk.sign(msg) for sk in sks])
+        sets.append(
+            SignatureSet(
+                signature=Signature(point=agg.point, subgroup_checked=True),
+                signing_keys=[sk.public_key() for sk in sks],
+                message=msg,
+            )
+        )
+    return sets
+
+
+def main():
+    import jax
+
+    from lighthouse_tpu.ops import backend as be
+
+    sets = _make_sets()
+    n_dev = len(jax.devices())
+    sharded = n_dev > 1 and N_SETS % n_dev == 0
+
+    # Warm-up: compile (persistent-cached) + one correctness check.
+    ok = be.verify_signature_sets_tpu(sets, sharded=sharded)
+    assert ok, "benchmark batch must verify"
+
+    t0 = time.perf_counter()
+    for _ in range(TIMED_ITERS):
+        assert be.verify_signature_sets_tpu(sets, sharded=sharded)
+    dt = time.perf_counter() - t0
+
+    sigs_per_sec = N_SETS * TIMED_ITERS / dt
+    print(
+        json.dumps(
+            {
+                "metric": "bls_batch_verify_throughput",
+                "value": round(sigs_per_sec, 2),
+                "unit": "sigs/sec",
+                "vs_baseline": round(sigs_per_sec / BLST_CPU_BASELINE_SIGS_PER_SEC, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

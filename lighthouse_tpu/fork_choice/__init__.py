@@ -1,0 +1,18 @@
+"""L3 — fork choice (SURVEY.md §1 L3).
+
+Mirror of `consensus/proto_array` + `consensus/fork_choice`: LMD-GHOST over
+a proto-array DAG with Casper FFG justification gating, proposer boost,
+equivocation discounting, and optimistic-execution status tracking.
+"""
+
+from .proto_array import ProtoArrayForkChoice, ProtoNode, ExecutionStatus
+from .fork_choice import ForkChoice, ForkChoiceError, QueuedAttestation
+
+__all__ = [
+    "ProtoArrayForkChoice",
+    "ProtoNode",
+    "ExecutionStatus",
+    "ForkChoice",
+    "ForkChoiceError",
+    "QueuedAttestation",
+]

@@ -1,0 +1,195 @@
+"""ForkChoice — the spec wrapper over proto-array.
+
+Mirror of consensus/fork_choice/src/fork_choice.rs: `on_block` (:653)
+validates descent/finality and feeds the DAG + unrealized-justification
+tracking, `on_attestation` (:1090) validates LMD votes with the one-epoch
+queueing rule, `on_attester_slashing` (:1142) removes equivocators,
+`get_head` (:483) recomputes balances-weighted LMD-GHOST with proposer
+boost. Time is injected (slot), never read from a clock — the chain layer
+owns the slot clock (common/slot_clock).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from .proto_array import ExecutionStatus, ProtoArrayForkChoice, ProtoArrayError
+
+
+class ForkChoiceError(Exception):
+    pass
+
+
+@dataclass
+class QueuedAttestation:
+    """Attestation for the current slot — applicable from the next slot
+    (fork_choice.rs queued_attestations)."""
+
+    slot: int
+    validator_indices: List[int]
+    block_root: bytes
+    target_epoch: int
+
+
+@dataclass
+class CheckpointSnapshot:
+    epoch: int
+    root: bytes
+
+
+class ForkChoice:
+    def __init__(self, spec, anchor_root: bytes, anchor_slot: int,
+                 justified: CheckpointSnapshot, finalized: CheckpointSnapshot,
+                 execution_status: ExecutionStatus = ExecutionStatus.IRRELEVANT,
+                 execution_block_hash: Optional[bytes] = None):
+        self.spec = spec
+        self.proto = ProtoArrayForkChoice(
+            finalized_root=anchor_root,
+            finalized_slot=anchor_slot,
+            justified_epoch=justified.epoch,
+            finalized_epoch=finalized.epoch,
+            execution_status=execution_status,
+            execution_block_hash=execution_block_hash,
+        )
+        self.justified = justified
+        self.finalized = finalized
+        # Best justified seen (spec's store.best_justified was removed in
+        # later fork-choice spec versions; we adopt the current rule:
+        # justified updates immediately).
+        self.queued_attestations: List[QueuedAttestation] = []
+        self.justified_balances: List[int] = []
+
+    # ------------------------------------------------------------- on_block
+
+    def on_block(self, current_slot: int, block, block_root: bytes,
+                 state, types, spec,
+                 execution_status: ExecutionStatus = ExecutionStatus.IRRELEVANT,
+                 execution_block_hash: Optional[bytes] = None) -> None:
+        """`state` is the post-state of `block` (the reference passes the
+        same; fork_choice.rs:653)."""
+        if block.slot > current_slot:
+            raise ForkChoiceError("block from the future")
+        if self.proto.contains_block(block_root):
+            return
+        if not self.proto.contains_block(bytes(block.parent_root)):
+            raise ForkChoiceError("unknown parent")
+        fin_slot = spec.start_slot_of_epoch(self.finalized.epoch)
+        if block.slot <= fin_slot:
+            raise ForkChoiceError("block before finalized slot")
+        if self.finalized.root != self.proto.nodes[0].root and not self.proto.is_descendant(
+            self.finalized.root, bytes(block.parent_root)
+        ):
+            raise ForkChoiceError("block does not descend from finalized root")
+
+        state_justified = CheckpointSnapshot(
+            epoch=state.current_justified_checkpoint.epoch,
+            root=bytes(state.current_justified_checkpoint.root),
+        )
+        state_finalized = CheckpointSnapshot(
+            epoch=state.finalized_checkpoint.epoch,
+            root=bytes(state.finalized_checkpoint.root),
+        )
+        if state_justified.epoch > self.justified.epoch:
+            self.justified = state_justified
+            self._refresh_justified_balances(state, spec)
+        if state_finalized.epoch > self.finalized.epoch:
+            self.finalized = state_finalized
+
+        self.proto.on_block(
+            slot=block.slot,
+            root=block_root,
+            parent_root=bytes(block.parent_root),
+            justified_epoch=state_justified.epoch,
+            finalized_epoch=state_finalized.epoch,
+            execution_status=execution_status,
+            execution_block_hash=execution_block_hash,
+        )
+
+    def _refresh_justified_balances(self, state, spec) -> None:
+        from lighthouse_tpu.state_transition import helpers as h
+
+        epoch = h.get_current_epoch(state, spec)
+        self.justified_balances = [
+            v.effective_balance if h.is_active_validator(v, epoch) else 0
+            for v in state.validators
+        ]
+
+    # -------------------------------------------------------- on_attestation
+
+    def on_attestation(self, current_slot: int, validator_indices: List[int],
+                       block_root: bytes, target_epoch: int,
+                       attestation_slot: int, is_from_block: bool = False) -> None:
+        """LMD vote intake. Votes for the current slot are queued one slot
+        (fork_choice.rs:1090 + queued_attestations)."""
+        if not is_from_block:
+            cur_epoch = self.spec.epoch_at_slot(current_slot)
+            if target_epoch not in (cur_epoch, cur_epoch - 1):
+                raise ForkChoiceError("attestation target epoch not current/previous")
+        if not self.proto.contains_block(block_root):
+            raise ForkChoiceError("attestation for unknown block")
+        if attestation_slot >= current_slot and not is_from_block:
+            self.queued_attestations.append(
+                QueuedAttestation(
+                    slot=attestation_slot,
+                    validator_indices=list(validator_indices),
+                    block_root=block_root,
+                    target_epoch=target_epoch,
+                )
+            )
+            return
+        for v in validator_indices:
+            self.proto.process_attestation(v, block_root, target_epoch)
+
+    def on_attester_slashing(self, attesting_indices_1, attesting_indices_2) -> None:
+        for v in set(attesting_indices_1) & set(attesting_indices_2):
+            self.proto.process_equivocation(v)
+
+    def process_queued_attestations(self, current_slot: int) -> None:
+        ready = [q for q in self.queued_attestations if q.slot < current_slot]
+        self.queued_attestations = [
+            q for q in self.queued_attestations if q.slot >= current_slot
+        ]
+        for q in ready:
+            for v in q.validator_indices:
+                self.proto.process_attestation(v, q.block_root, q.target_epoch)
+
+    # -------------------------------------------------------- proposer boost
+
+    def on_proposer_boost(self, block_root: bytes, slot: int) -> None:
+        """Set the transient boost for a timely current-slot block; expires
+        when the slot advances (the reference clears it on_tick)."""
+        self.proto.proposer_boost_root = block_root
+        self._proposer_boost_slot = slot
+
+    def _proposer_boost_amount(self) -> int:
+        if not self.justified_balances:
+            return 0
+        total = sum(self.justified_balances)
+        committee_weight = total // self.spec.preset.SLOTS_PER_EPOCH
+        return committee_weight * self.spec.proposer_score_boost // 100
+
+    # --------------------------------------------------------------- get_head
+
+    def get_head(self, current_slot: int) -> bytes:
+        self.process_queued_attestations(current_slot)
+        if getattr(self, "_proposer_boost_slot", None) is not None and \
+                current_slot > self._proposer_boost_slot:
+            self.proto.proposer_boost_root = b"\x00" * 32
+            self._proposer_boost_slot = None
+        self.proto.apply_score_changes(
+            new_balances=self.justified_balances,
+            justified_epoch=self.justified.epoch,
+            finalized_epoch=self.finalized.epoch,
+            proposer_boost_amount=self._proposer_boost_amount(),
+        )
+        start = (
+            self.justified.root
+            if self.proto.contains_block(self.justified.root)
+            else self.proto.nodes[0].root
+        )
+        return self.proto.find_head(start)
+
+    def prune(self) -> None:
+        if self.proto.contains_block(self.finalized.root):
+            self.proto.prune(self.finalized.root)

@@ -1,0 +1,300 @@
+"""Proto-array: the fork-choice DAG with O(n) weight propagation.
+
+Mirror of consensus/proto_array (proto_array.rs, proto_array_fork_choice.rs):
+nodes are appended in insertion order so every parent index precedes its
+children; vote-movement deltas propagate to ancestors in ONE reverse sweep.
+Vote tracking (one latest message per validator), transient proposer boost,
+equivocation exclusion, FFG viability filtering, and optimistic-execution
+status follow the reference's semantics.
+
+Simplification vs the reference: head selection walks the children index
+greedily (O(unfinalized nodes)) instead of maintaining best-child /
+best-descendant pointers incrementally — pruning keeps n small (hundreds),
+and the flat-array layout leaves a numpy/JAX vectorization of the sweep as a
+drop-in if validator-scale demands it.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set
+
+
+class ExecutionStatus(enum.Enum):
+    """Optimistic-sync status of a node's payload (proto_array.rs)."""
+
+    VALID = "valid"
+    INVALID = "invalid"
+    OPTIMISTIC = "optimistic"   # imported before EL verification
+    IRRELEVANT = "irrelevant"   # pre-merge block
+
+
+@dataclass
+class ProtoNode:
+    slot: int
+    root: bytes
+    parent: Optional[int]
+    justified_epoch: int
+    finalized_epoch: int
+    weight: int = 0
+    execution_status: ExecutionStatus = ExecutionStatus.IRRELEVANT
+    execution_block_hash: Optional[bytes] = None
+
+
+@dataclass
+class VoteTracker:
+    current_root: bytes = b"\x00" * 32
+    next_root: bytes = b"\x00" * 32
+    # -1 = no message yet, so a genesis-epoch (epoch 0) first vote registers.
+    next_epoch: int = -1
+
+
+class ProtoArrayError(Exception):
+    pass
+
+
+class ProtoArrayForkChoice:
+    def __init__(
+        self,
+        finalized_root: bytes,
+        finalized_slot: int,
+        justified_epoch: int,
+        finalized_epoch: int,
+        execution_status: ExecutionStatus = ExecutionStatus.IRRELEVANT,
+        execution_block_hash: Optional[bytes] = None,
+    ):
+        self.nodes: List[ProtoNode] = []
+        self.index_by_root: Dict[bytes, int] = {}
+        self.children: Dict[int, List[int]] = {}
+        self.justified_epoch = justified_epoch
+        self.finalized_epoch = finalized_epoch
+        self.votes: Dict[int, VoteTracker] = {}
+        self.balances: List[int] = []
+        self.equivocating_indices: Set[int] = set()
+        # Transient proposer boost: (root, amount applied last sweep).
+        self.proposer_boost_root: bytes = b"\x00" * 32
+        self._applied_boost: tuple = (None, 0)  # (node index, amount)
+        self._append(
+            ProtoNode(
+                slot=finalized_slot, root=finalized_root, parent=None,
+                justified_epoch=justified_epoch, finalized_epoch=finalized_epoch,
+                execution_status=execution_status,
+                execution_block_hash=execution_block_hash,
+            )
+        )
+
+    # ------------------------------------------------------------------ DAG
+
+    def _append(self, node: ProtoNode) -> None:
+        idx = len(self.nodes)
+        self.nodes.append(node)
+        self.index_by_root[node.root] = idx
+        self.children.setdefault(idx, [])
+        if node.parent is not None:
+            self.children.setdefault(node.parent, []).append(idx)
+
+    def on_block(self, slot, root, parent_root, justified_epoch, finalized_epoch,
+                 execution_status=ExecutionStatus.IRRELEVANT,
+                 execution_block_hash=None) -> None:
+        if root in self.index_by_root:
+            return
+        if parent_root not in self.index_by_root:
+            raise ProtoArrayError(f"unknown parent {parent_root.hex()[:8]}")
+        self._append(
+            ProtoNode(
+                slot=slot, root=root, parent=self.index_by_root[parent_root],
+                justified_epoch=justified_epoch, finalized_epoch=finalized_epoch,
+                execution_status=execution_status,
+                execution_block_hash=execution_block_hash,
+            )
+        )
+
+    def contains_block(self, root: bytes) -> bool:
+        return root in self.index_by_root
+
+    def is_descendant(self, ancestor_root: bytes, descendant_root: bytes) -> bool:
+        a = self.index_by_root.get(ancestor_root)
+        d = self.index_by_root.get(descendant_root)
+        if a is None or d is None:
+            return False
+        while d is not None and d >= a:
+            if d == a:
+                return True
+            d = self.nodes[d].parent
+        return False
+
+    # ----------------------------------------------------------------- votes
+
+    def process_attestation(self, validator_index: int, block_root: bytes,
+                            target_epoch: int) -> None:
+        if validator_index in self.equivocating_indices:
+            return
+        vote = self.votes.setdefault(validator_index, VoteTracker())
+        if target_epoch > vote.next_epoch:
+            vote.next_root = block_root
+            vote.next_epoch = target_epoch
+
+    def process_equivocation(self, validator_index: int) -> None:
+        """Permanently remove an equivocating validator's weight (reference
+        fork_choice.rs:1142 on_attester_slashing path)."""
+        if validator_index in self.equivocating_indices:
+            return
+        self.equivocating_indices.add(validator_index)
+        vote = self.votes.get(validator_index)
+        if vote and vote.current_root in self.index_by_root:
+            bal = self.balances[validator_index] if validator_index < len(self.balances) else 0
+            if bal:
+                self._propagate({self.index_by_root[vote.current_root]: -bal})
+            vote.current_root = b"\x00" * 32
+
+    # ------------------------------------------------------------- weighting
+
+    def _propagate(self, deltas: Dict[int, int]) -> None:
+        """One reverse sweep pushing deltas up the ancestor chain."""
+        if not deltas:
+            return
+        acc = [0] * len(self.nodes)
+        for i, d in deltas.items():
+            acc[i] += d
+        for i in range(len(self.nodes) - 1, -1, -1):
+            if acc[i] == 0:
+                continue
+            self.nodes[i].weight += acc[i]
+            p = self.nodes[i].parent
+            if p is not None:
+                acc[p] += acc[i]
+
+    def apply_score_changes(self, new_balances: List[int], justified_epoch: int,
+                            finalized_epoch: int,
+                            proposer_boost_amount: int = 0) -> None:
+        """Move each validator's weight from its current vote to its next
+        vote (with updated balance), refresh the transient proposer boost,
+        and update the FFG filter epochs."""
+        deltas: Dict[int, int] = {}
+
+        def add(idx, amount):
+            if amount:
+                deltas[idx] = deltas.get(idx, 0) + amount
+
+        for vidx, vote in self.votes.items():
+            if vidx in self.equivocating_indices:
+                continue
+            old_bal = self.balances[vidx] if vidx < len(self.balances) else 0
+            new_bal = new_balances[vidx] if vidx < len(new_balances) else 0
+            cur = self.index_by_root.get(vote.current_root)
+            nxt = self.index_by_root.get(vote.next_root)
+            if nxt is not None:
+                if cur is not None:
+                    add(cur, -old_bal)
+                add(nxt, new_bal)
+                vote.current_root = vote.next_root
+            elif cur is not None and new_bal != old_bal:
+                add(cur, new_bal - old_bal)
+
+        # Remove last sweep's boost, apply this sweep's.
+        prev_idx, prev_amount = self._applied_boost
+        if prev_idx is not None:
+            add(prev_idx, -prev_amount)
+        boost_idx = self.index_by_root.get(self.proposer_boost_root)
+        if boost_idx is not None and proposer_boost_amount:
+            add(boost_idx, proposer_boost_amount)
+            self._applied_boost = (boost_idx, proposer_boost_amount)
+        else:
+            self._applied_boost = (None, 0)
+
+        self._propagate(deltas)
+        self.balances = list(new_balances)
+        self.justified_epoch = justified_epoch
+        self.finalized_epoch = finalized_epoch
+
+    # ------------------------------------------------------------- find head
+
+    def _node_is_viable_for_head(self, node: ProtoNode) -> bool:
+        if node.execution_status is ExecutionStatus.INVALID:
+            return False
+        ok_justified = (
+            node.justified_epoch == self.justified_epoch
+            or self.justified_epoch == 0
+        )
+        ok_finalized = (
+            node.finalized_epoch == self.finalized_epoch
+            or self.finalized_epoch == 0
+        )
+        return ok_justified and ok_finalized
+
+    def _leads_to_viable_head(self, idx: int) -> bool:
+        if self._node_is_viable_for_head(self.nodes[idx]):
+            return True
+        return any(self._leads_to_viable_head(c) for c in self.children.get(idx, []))
+
+    def find_head(self, justified_root: bytes) -> bytes:
+        if justified_root not in self.index_by_root:
+            raise ProtoArrayError("unknown justified root")
+        idx = self.index_by_root[justified_root]
+        while True:
+            viable_children = [
+                c for c in self.children.get(idx, [])
+                if self._leads_to_viable_head(c)
+            ]
+            if not viable_children:
+                return self.nodes[idx].root
+            # Tie-break on root bytes, matching the reference's ordering.
+            idx = max(
+                viable_children,
+                key=lambda c: (self.nodes[c].weight, self.nodes[c].root),
+            )
+
+    # --------------------------------------------------------------- pruning
+
+    def prune(self, new_finalized_root: bytes) -> None:
+        """Drop everything not in the finalized root's subtree (and the old
+        pre-finalized chain)."""
+        if new_finalized_root not in self.index_by_root:
+            raise ProtoArrayError("unknown finalized root")
+        fin_idx = self.index_by_root[new_finalized_root]
+        keep = {fin_idx}
+        for i in range(fin_idx + 1, len(self.nodes)):
+            if self.nodes[i].parent in keep:
+                keep.add(i)
+        remap = {}
+        new_nodes = []
+        for i in sorted(keep):
+            remap[i] = len(new_nodes)
+            new_nodes.append(self.nodes[i])
+        for n in new_nodes:
+            n.parent = remap.get(n.parent)
+        self.nodes = new_nodes
+        self.index_by_root = {n.root: i for i, n in enumerate(self.nodes)}
+        self.children = {i: [] for i in range(len(self.nodes))}
+        for i, n in enumerate(self.nodes):
+            if n.parent is not None:
+                self.children[n.parent].append(i)
+        self.nodes[remap[fin_idx]].parent = None
+
+    # ----------------------------------------------- optimistic-sync support
+
+    def on_execution_status(self, block_hash: bytes, valid: bool) -> None:
+        """EL verdict propagation: VALID ratifies the ancestor chain;
+        INVALID poisons the whole descendant subtree (payload_status.rs)."""
+        targets = [
+            i for i, n in enumerate(self.nodes)
+            if n.execution_block_hash == block_hash
+        ]
+        if not targets:
+            return
+        idx = targets[0]
+        if valid:
+            j: Optional[int] = idx
+            while j is not None:
+                n = self.nodes[j]
+                if n.execution_status is ExecutionStatus.OPTIMISTIC:
+                    n.execution_status = ExecutionStatus.VALID
+                j = n.parent
+        else:
+            invalid = {idx}
+            for i in range(idx + 1, len(self.nodes)):
+                if self.nodes[i].parent in invalid:
+                    invalid.add(i)
+            for i in invalid:
+                self.nodes[i].execution_status = ExecutionStatus.INVALID

@@ -1,0 +1,206 @@
+"""The TPU batch-verification backend — the north-star entry point.
+
+Implements `verify_signature_sets` (BASELINE.md) on device, semantics of
+blst's random-scalar batch verification as driven by the reference
+(crypto/bls/src/impls/blst.rs:36-118):
+
+    prod_i e([r_i] agg_pk_i, H(m_i)) * e(-g1, sum_i [r_i] sig_i) == 1
+
+with r_i nonzero 64-bit scalars from the HOST CSPRNG (device kernels stay
+deterministic; SURVEY.md §7.3 item 2).
+
+Staging design (the SignatureSet -> tensor ABI, SURVEY.md §7.1):
+  * sets are padded to power-of-two buckets on both axes — set count and
+    pubkeys-per-set — so each (n_bucket, k_bucket) shape compiles once and
+    is reused forever (persistent cache);
+  * pubkey padding is the INFINITY point: the complete RCB group law absorbs
+    it in the per-set aggregation tree with no masking;
+  * padded sets ride a mask into the pairing (contribute 1 to the product);
+  * per-set validity (signature subgroup membership, non-infinity aggregate
+    pubkey) is computed on device and ANDed with the pairing bit — one bool
+    comes back to the host.
+
+Fallback semantics on False match the reference: the caller re-verifies
+per-set to find the poisoned item (attestation_verification/batch.rs:123-134).
+"""
+
+import secrets
+from functools import lru_cache
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.bls import api as _api
+from lighthouse_tpu.crypto.bls import curves as _oc
+from lighthouse_tpu.crypto.bls.constants import P as _P
+from lighthouse_tpu.crypto.bls.constants import RAND_BITS as _RAND_BITS
+
+from . import curves as cv
+from . import h2c
+from . import limbs as lb
+from . import pairing as pr
+from . import tower as tw
+
+# -g1 generator, staged once (the constant pair of the batch equation).
+_NEG_G1_AFF = lb.ints_to_mont(
+    [(_oc.G1_GEN[0]), (_P - _oc.G1_GEN[1])]
+).reshape(2, lb.L)
+
+
+def _next_pow2(n: int, floor: int = 1) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+# ---------------------------------------------------------------------------
+# Jitted core (cached per bucket shape)
+# ---------------------------------------------------------------------------
+
+
+def _verify_core(u, pk_proj, sig_proj, sig_checked, set_mask, scalars):
+    """Device graph for one bucket shape.
+
+    u:           (n, 2, 2, L)    hash_to_field outputs per message
+    pk_proj:     (n, K, 3, L)    projective pubkeys, padded with infinity
+    sig_proj:    (n, 3, 2, L)    projective signatures (infinity for padding)
+    sig_checked: (n,) bool       host-side subgroup-check amortization flag
+    set_mask:    (n,) bool       True for real sets
+    scalars:     (n,) uint64     nonzero random batch coefficients
+    """
+    n = u.shape[0]
+    # H(m_i): the field-heavy half of hash-to-curve, batched.
+    h_proj = h2c.hash_to_g2_device(u)                             # (n, 3, 2, L)
+
+    # Aggregate pubkeys per set: tree over the K axis (complete adds absorb
+    # the infinity padding).
+    agg = lb.tree_reduce(
+        jnp.moveaxis(pk_proj, 1, 0), cv.G1.add, cv.G1.infinity, pk_proj.shape[1]
+    )                                                             # (n, 3, L)
+    agg_inf = cv.G1.is_infinity(agg)
+
+    # Signature subgroup membership (skipped where the host already paid it —
+    # mirrors Signature.subgroup_checked amortization in the oracle API).
+    sig_ok = jnp.logical_or(sig_checked, cv.g2_in_subgroup(sig_proj))
+
+    # Random-scalar weighting: A_i = [r_i] agg_pk_i ; S = sum_i [r_i] sig_i.
+    a_proj = cv.G1.mul_var_scalar(agg, scalars)                   # (n, 3, L)
+    rsig = cv.G2.mul_var_scalar(sig_proj, scalars)                # (n, 3, 2, L)
+    s_proj = lb.tree_reduce(rsig, cv.G2.add, cv.G2.infinity, n)   # (3, 2, L)
+
+    # Stage the n+1 pairs (the +1 is the constant -g1 against S).
+    p_aff = jnp.concatenate(
+        [pr.to_affine_g1(a_proj), jnp.broadcast_to(_NEG_G1_AFF, (1, 2, lb.L))]
+    )
+    q_aff = jnp.concatenate(
+        [pr.to_affine_g2(h_proj), pr.to_affine_g2(s_proj)[None]]
+    )
+    mask = jnp.concatenate([set_mask, jnp.ones((1,), dtype=bool)])
+
+    pairing_ok = pr.multi_pairing_is_one(p_aff, q_aff, mask)
+    sets_valid = jnp.all(
+        jnp.where(set_mask, jnp.logical_and(sig_ok, ~agg_inf), True)
+    )
+    return jnp.logical_and(pairing_ok, sets_valid)
+
+
+@lru_cache(maxsize=None)
+def _jitted_core(n_bucket: int, k_bucket: int, sharded: bool):
+    del n_bucket, k_bucket  # cache key only; shapes live in the arguments
+    if not sharded:
+        return jax.jit(_verify_core)
+
+    from lighthouse_tpu.parallel import mesh as pm
+
+    def core(u, pk_proj, sig_proj, sig_checked, set_mask, scalars):
+        m = pm.get_mesh()
+        sh = pm.batch_sharding(m)
+        args = [
+            jax.lax.with_sharding_constraint(x, sh)
+            for x in (u, pk_proj, sig_proj, sig_checked, set_mask, scalars)
+        ]
+        return _verify_core(*args)
+
+    return jax.jit(core)
+
+
+# ---------------------------------------------------------------------------
+# Host staging
+# ---------------------------------------------------------------------------
+
+
+def verify_signature_sets_tpu(
+    sets: Sequence["_api.SignatureSet"], sharded: Optional[bool] = None
+) -> bool:
+    """Stage SignatureSets into bucket tensors and run the device check.
+
+    Host-side early-outs replicate the oracle/blst rejects exactly
+    (api.verify_signature_sets_oracle): empty batch, empty signing_keys,
+    infinity signature.
+    """
+    sets = list(sets)
+    if not sets:
+        return False
+    for s in sets:
+        if not s.signing_keys:
+            return False
+        if s.signature.point is None:
+            return False
+
+    n = len(sets)
+    k_max = max(len(s.signing_keys) for s in sets)
+    if sharded is None:
+        sharded = len(jax.devices()) > 1
+    floor_n = len(jax.devices()) if sharded else 1
+    n_bucket = _next_pow2(n, floor=max(1, floor_n))
+    k_bucket = _next_pow2(k_max)
+
+    # --- stage tensors (host ints -> Montgomery limbs) --------------------
+    u = np.zeros((n_bucket, 2, 2, lb.L), dtype=np.uint64)
+    u_real = h2c.hash_to_field_device([s.message for s in sets])
+    u[:n] = np.asarray(u_real)
+
+    pk_pts = []
+    for s in sets:
+        pts = [pk.point for pk in s.signing_keys]
+        pts += [None] * (k_bucket - len(pts))
+        pk_pts.extend(pts)
+    pk_pts += [None] * ((n_bucket - n) * k_bucket)
+    pk_proj = cv.g1_from_affine(pk_pts).reshape(n_bucket, k_bucket, 3, lb.L)
+
+    sig_pts = [s.signature.point for s in sets] + [None] * (n_bucket - n)
+    sig_proj = cv.g2_from_affine(sig_pts)
+
+    sig_checked = np.zeros((n_bucket,), dtype=bool)
+    sig_checked[:n] = [s.signature.subgroup_checked for s in sets]
+    sig_checked[n:] = True  # padding: skip the device check
+
+    set_mask = np.zeros((n_bucket,), dtype=bool)
+    set_mask[:n] = True
+
+    scalars = np.ones((n_bucket,), dtype=np.uint64)
+    for i in range(n):
+        r = 0
+        while r == 0:
+            r = secrets.randbits(_RAND_BITS)
+        scalars[i] = r
+
+    core = _jitted_core(n_bucket, k_bucket, bool(sharded))
+    out = core(
+        jnp.asarray(u),
+        pk_proj,
+        sig_proj,
+        jnp.asarray(sig_checked),
+        jnp.asarray(set_mask),
+        jnp.asarray(scalars),
+    )
+    return bool(out)
+
+
+# Register with the API seam (mirrors define_mod! backend instantiation,
+# crypto/bls/src/lib.rs:99-140).
+_api.register_backend("tpu", verify_signature_sets_tpu)

@@ -1,0 +1,171 @@
+"""RFC 9380 hash-to-curve for G2 (JAX device path, batched).
+
+Split matching the TPU cost model (SURVEY.md §7.3 item 5):
+  * expand_message_xmd / hash_to_field run on the HOST (SHA-256 is a byte
+    shuffle the TPU hates; ~microseconds per message) — reusing the oracle's
+    spec implementation (crypto/bls/hash_to_curve.py:27-56).
+  * Everything field-heavy — the simplified SWU map, the 3-isogeny, cofactor
+    clearing — runs on DEVICE, batched over messages: per message the map
+    costs two ~760-bit fixed exponentiations (sqrt candidates), two field
+    inversions, and two 64-bit scalar scans for the cofactor; all of it
+    vmapped over the batch axis.
+
+Branch-free: every RFC conditional (exceptional tv=0, gx1-not-square,
+sign fix, isogeny kernel) becomes a masked select; the isogeny emits a
+PROJECTIVE point so its kernel (x_den = 0) maps to infinity without a branch.
+
+Replaces blst's hash_to_g2 (reference pins the DST at
+crypto/bls/src/impls/blst.rs:14). Differentially tested against the oracle
+in tests/test_ops_h2c.py.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.bls import hash_to_curve as oh2c
+from lighthouse_tpu.crypto.bls.constants import (
+    DST_G2,
+    ISO3_X_DEN,
+    ISO3_X_NUM,
+    ISO3_Y_DEN,
+    ISO3_Y_NUM,
+    SSWU_A2,
+    SSWU_B2,
+    SSWU_Z2,
+)
+
+from . import curves as cv
+from . import limbs as lb
+from . import tower as tw
+
+# --- Device constants (staged once at import) ------------------------------
+
+_A = tw.fp2_from_int_pair([SSWU_A2])[0]
+_B = tw.fp2_from_int_pair([SSWU_B2])[0]
+_Z = tw.fp2_from_int_pair([SSWU_Z2])[0]
+
+# Exceptional-case x1 = B / (Z * A) is a compile-time constant.
+from lighthouse_tpu.crypto.bls import fields as _of  # noqa: E402
+
+_X1_EXC = tw.fp2_from_int_pair(
+    [_of.fp2_mul(SSWU_B2, _of.fp2_inv(_of.fp2_mul(SSWU_Z2, SSWU_A2)))]
+)[0]
+_MINUS_B_OVER_A = tw.fp2_from_int_pair(
+    [_of.fp2_neg(_of.fp2_mul(SSWU_B2, _of.fp2_inv(SSWU_A2)))]
+)[0]
+
+
+def _stack_coeffs(coeffs):
+    return jnp.stack([tw.fp2_from_int_pair([c])[0] for c in coeffs])
+
+
+_XN = _stack_coeffs(ISO3_X_NUM)
+_XD = _stack_coeffs(ISO3_X_DEN)
+_YN = _stack_coeffs(ISO3_Y_NUM)
+_YD = _stack_coeffs(ISO3_Y_DEN)
+
+
+# --- Host staging ----------------------------------------------------------
+
+
+def hash_to_field_device(messages, dst: bytes = DST_G2):
+    """Host: SHA-256 hash_to_field per message -> (n, 2, 2, L) device limbs
+    (two Fp2 elements u0, u1 per message, Montgomery form)."""
+    flat = []
+    for msg in messages:
+        u0, u1 = oh2c.hash_to_field_fp2(msg, 2, dst)
+        flat.extend([u0[0], u0[1], u1[0], u1[1]])
+    return lb.ints_to_mont(flat).reshape(-1, 2, 2, lb.L)
+
+
+# --- Device map ------------------------------------------------------------
+
+
+def _sgn0_fp2(a):
+    """RFC 9380 §4.1 sgn0 for Fp2 (standard-form parity; a is Montgomery)."""
+    std = lb.from_mont(a)                      # (..., 2, L)
+    a0, a1 = std[..., 0, :], std[..., 1, :]
+    sign0 = (a0[..., 0] & jnp.uint64(1)) == 1
+    zero0 = jnp.all(a0 == 0, axis=-1)
+    sign1 = (a1[..., 0] & jnp.uint64(1)) == 1
+    return jnp.logical_or(sign0, jnp.logical_and(zero0, sign1))
+
+
+def map_to_curve_sswu(u):
+    """Batched simplified SWU: u (..., 2, L) -> affine point on E2' (iso
+    curve), shape (..., 2, 2, L). Mirrors the oracle's branches
+    (hash_to_curve.py:59-83) as masked selects."""
+    zu2 = tw.fp2_mul(jnp.broadcast_to(_Z, u.shape), tw.fp2_sqr(u))
+    tv = lb.add(tw.fp2_sqr(zu2), zu2)
+    tv_zero = tw.fp2_is_zero(tv)
+    # 1/tv with tv=0 mapped safely (result unused under the mask).
+    tv_inv = tw.fp2_inv(tw.fp2_select(tv_zero, jnp.broadcast_to(tw.FP2_ONE, tv.shape), tv))
+    x1_main = tw.fp2_mul(
+        jnp.broadcast_to(_MINUS_B_OVER_A, u.shape),
+        lb.add(jnp.broadcast_to(tw.FP2_ONE, tv_inv.shape), tv_inv),
+    )
+    x1 = tw.fp2_select(tv_zero, jnp.broadcast_to(_X1_EXC, x1_main.shape), x1_main)
+
+    def gx(x):
+        # x^3 + A x + B
+        x2 = tw.fp2_sqr(x)
+        m = tw.fp2_mul(
+            jnp.stack([x2, jnp.broadcast_to(_A, x.shape)], axis=-3),
+            jnp.stack([x, x], axis=-3),
+        )
+        return lb.add(lb.add(m[..., 0, :, :], m[..., 1, :, :]), jnp.broadcast_to(_B, x.shape))
+
+    gx1 = gx(x1)
+    y1, ok1 = tw.fp2_sqrt(gx1)
+    x2 = tw.fp2_mul(zu2, x1)
+    gx2 = gx(x2)
+    y2, _ok2 = tw.fp2_sqrt(gx2)
+
+    x = tw.fp2_select(ok1, x1, x2)
+    y = tw.fp2_select(ok1, y1, y2)
+    # Sign fix: sgn0(u) == sgn0(y), else negate y.
+    flip = jnp.logical_xor(_sgn0_fp2(u), _sgn0_fp2(y))
+    y = tw.fp2_select(flip, lb.neg(y), y)
+    return jnp.stack([x, y], axis=-3)
+
+
+def _horner(coeffs, x):
+    """Evaluate sum coeffs[i] x^i with constant Fp2 coeffs (batched x)."""
+    acc = jnp.broadcast_to(coeffs[-1], x.shape)
+    for i in range(coeffs.shape[0] - 2, -1, -1):
+        acc = lb.add(tw.fp2_mul(acc, x), jnp.broadcast_to(coeffs[i], x.shape))
+    return acc
+
+
+def iso_map_projective(pt):
+    """3-isogeny E2' -> E2 (RFC 9380 App. E.3), emitting a PROJECTIVE point:
+    (x_num*y_den, y*y_num*x_den, x_den*y_den). The kernel (x_den = 0) lands
+    on (_, _, 0) = infinity — branch-free, unlike the oracle's None return
+    (hash_to_curve.py:102-103)."""
+    x = pt[..., 0, :, :]
+    y = pt[..., 1, :, :]
+    xn, xd, yn, yd = _horner(_XN, x), _horner(_XD, x), _horner(_YN, x), _horner(_YD, x)
+    m = tw.fp2_mul(
+        jnp.stack([xn, yn, xd], axis=-3),
+        jnp.stack([yd, y, yd], axis=-3),
+    )
+    X = m[..., 0, :, :]
+    yyn = m[..., 1, :, :]
+    Z = m[..., 2, :, :]
+    Y = tw.fp2_mul(yyn, xd)
+    return cv.G2.pack(X, Y, Z)
+
+
+def hash_to_g2_device(u):
+    """Device: (n, 2, 2, L) field elements (u0, u1 per message) -> (n, 3, 2, L)
+    projective G2 points. Full map: SSWU x2, isogeny, add, clear cofactor."""
+    q = iso_map_projective(map_to_curve_sswu(u))       # (n, 2, 3, 2, L)
+    s = cv.G2.add(q[..., 0, :, :, :], q[..., 1, :, :, :])
+    return cv.g2_clear_cofactor(s)
+
+
+def hash_to_g2(messages, dst: bytes = DST_G2):
+    """Host+device composite: messages -> (n, 3, 2, L) projective G2."""
+    u = hash_to_field_device(messages, dst)
+    return hash_to_g2_device(u)

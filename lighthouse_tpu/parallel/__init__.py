@@ -1,0 +1,15 @@
+"""Device-mesh / sharding layer — the ICI "communication backend".
+
+The reference scales BLS verification with a rayon thread pool
+(consensus/state_processing/src/per_block_processing/block_signature_verifier.rs:396-404)
+and shards gossip load over attestation subnets (SURVEY.md §2.8). The TPU
+equivalent is batch-axis data parallelism over a `jax.sharding.Mesh`: the
+signature-set axis is sharded across devices, every per-set computation
+(hash-to-curve, pubkey aggregation, scalar muls, Miller loops) runs locally,
+and the two cross-set reductions (GT product, G2 signature sum) become XLA
+collectives over ICI inserted automatically from sharding constraints.
+"""
+
+from .mesh import batch_sharding, get_mesh, shard_batch
+
+__all__ = ["get_mesh", "batch_sharding", "shard_batch"]

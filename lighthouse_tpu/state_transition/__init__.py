@@ -1,0 +1,31 @@
+"""L2 — pure state-transition functions (SURVEY.md §1 L2).
+
+Mirror of `consensus/state_processing`: side-effect-free functions over
+BeaconState — per-slot/per-block/per-epoch processing, the signature-set
+factory, and the bulk block-signature verifier with the reference's
+`BlockSignatureStrategy` seam (per_block_processing.rs:54-62).
+"""
+
+from .signature_sets import (
+    SignatureSetError,
+    attester_slashing_signature_sets,
+    block_proposal_signature_set,
+    bls_execution_change_signature_set,
+    deposit_signature_set,
+    indexed_attestation_signature_set,
+    proposer_slashing_signature_sets,
+    randao_signature_set,
+    voluntary_exit_signature_set,
+)
+
+__all__ = [
+    "SignatureSetError",
+    "block_proposal_signature_set",
+    "randao_signature_set",
+    "indexed_attestation_signature_set",
+    "proposer_slashing_signature_sets",
+    "attester_slashing_signature_sets",
+    "deposit_signature_set",
+    "voluntary_exit_signature_set",
+    "bls_execution_change_signature_set",
+]

@@ -1,0 +1,738 @@
+"""per_block_processing — the spec block transition (altair+ accounting).
+
+Mirror of consensus/state_processing/src/per_block_processing.rs:100 and
+process_operations.rs:12. Signature handling follows the reference's
+`BlockSignatureStrategy` seam (per_block_processing.rs:54-62): callers either
+verify in bulk beforehand (VerifyBulk → BlockSignatureVerifier) and run this
+with VerifySignatures.FALSE, or let each operation verify individually.
+
+Fork coverage: altair/bellatrix/capella/deneb bodies (phase0 PendingAttestation
+accounting intentionally unsupported — genesis starts at capella for the
+end-to-end slice; SURVEY.md §7.2 step 2).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional
+
+from lighthouse_tpu.crypto.bls import api as bls
+from lighthouse_tpu.types import ssz
+from lighthouse_tpu.types.spec import (
+    DOMAIN_BEACON_ATTESTER,
+    FAR_FUTURE_EPOCH,
+    ForkName,
+    PARTICIPATION_FLAG_WEIGHTS,
+    PROPOSER_WEIGHT,
+    SYNC_REWARD_WEIGHT,
+    TIMELY_HEAD_FLAG_INDEX,
+    TIMELY_SOURCE_FLAG_INDEX,
+    TIMELY_TARGET_FLAG_INDEX,
+    WEIGHT_DENOMINATOR,
+)
+
+from . import helpers as h
+from . import signature_sets as sigsets
+
+
+class VerifySignatures(enum.Enum):
+    TRUE = "true"
+    FALSE = "false"  # signatures were verified in bulk beforehand
+
+
+class BlockProcessingError(Exception):
+    pass
+
+
+def _require(cond: bool, msg: str) -> None:
+    if not cond:
+        raise BlockProcessingError(msg)
+
+
+def _verify_set(sig_set, verify: VerifySignatures) -> None:
+    if verify is VerifySignatures.TRUE:
+        _require(
+            bls.verify_signature_sets([sig_set]), "signature verification failed"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Top level
+# ---------------------------------------------------------------------------
+
+
+def per_block_processing(
+    state, types, spec, signed_block, fork: str,
+    verify_signatures: VerifySignatures = VerifySignatures.TRUE,
+    get_pubkey=None,
+    verify_block_signature: bool = True,
+) -> None:
+    """Apply `signed_block` to `state` in place (state.slot must equal
+    block.slot — callers run process_slots first, state_advance.rs style)."""
+    block = signed_block.message
+    if get_pubkey is None:
+        get_pubkey = default_pubkey_getter(state)
+
+    if verify_signatures is VerifySignatures.TRUE and verify_block_signature:
+        _verify_set(
+            sigsets.block_proposal_signature_set(
+                state, types, spec, signed_block, fork, get_pubkey
+            ),
+            verify_signatures,
+        )
+
+    process_block_header(state, types, spec, block)
+    if ForkName.ge(fork, ForkName.BELLATRIX):
+        process_withdrawals(state, types, spec, block.body.execution_payload, fork)
+        process_execution_payload(state, types, spec, block.body, fork)
+    process_randao(state, types, spec, block, fork, verify_signatures, get_pubkey)
+    process_eth1_data(state, types, spec, block.body)
+    process_operations(state, types, spec, block.body, fork, verify_signatures, get_pubkey)
+    process_sync_aggregate(
+        state, types, spec, block.body.sync_aggregate, verify_signatures, get_pubkey
+    )
+
+
+def default_pubkey_getter(state):
+    """Decompress pubkeys straight from the state (slow path — the chain
+    layer substitutes its validator_pubkey_cache, mirroring
+    validator_pubkey_cache.rs:10-23)."""
+    cache = {}
+
+    def get(i: int):
+        if i >= len(state.validators):
+            return None
+        if i not in cache:
+            try:
+                cache[i] = bls.PublicKey.from_bytes(state.validators[i].pubkey)
+            except bls.BlsError:
+                return None
+        return cache[i]
+
+    return get
+
+
+# ---------------------------------------------------------------------------
+# Header / randao / eth1
+# ---------------------------------------------------------------------------
+
+
+def process_block_header(state, types, spec, block) -> None:
+    _require(block.slot == state.slot, "block slot != state slot")
+    _require(
+        block.slot > state.latest_block_header.slot, "block not newer than header"
+    )
+    _require(
+        block.proposer_index == h.get_beacon_proposer_index(state, spec),
+        "wrong proposer index",
+    )
+    _require(
+        block.parent_root
+        == types.BeaconBlockHeader.hash_tree_root(state.latest_block_header),
+        "parent root mismatch",
+    )
+    proposer = state.validators[block.proposer_index]
+    _require(not proposer.slashed, "proposer slashed")
+
+    body_cls = types.BeaconBlockBody[_fork_of_body(types, block.body)]
+    state.latest_block_header = types.BeaconBlockHeader(
+        slot=block.slot,
+        proposer_index=block.proposer_index,
+        parent_root=block.parent_root,
+        state_root=b"\x00" * 32,  # filled at next slot processing
+        body_root=body_cls.hash_tree_root(block.body),
+    )
+
+
+def _fork_of_body(types, body) -> str:
+    for fork, cls in types.BeaconBlockBody.items():
+        if isinstance(body, cls):
+            return fork
+    raise BlockProcessingError("unknown block body type")
+
+
+def process_randao(state, types, spec, block, fork, verify_signatures, get_pubkey) -> None:
+    epoch = h.get_current_epoch(state, spec)
+    if verify_signatures is VerifySignatures.TRUE:
+        _verify_set(
+            sigsets.randao_signature_set(
+                state, types, spec, block.proposer_index, epoch,
+                block.body.randao_reveal, get_pubkey,
+            ),
+            verify_signatures,
+        )
+    import hashlib
+
+    mix = bytes(
+        a ^ b
+        for a, b in zip(
+            h.get_randao_mix(state, spec, epoch),
+            hashlib.sha256(bytes(block.body.randao_reveal)).digest(),
+        )
+    )
+    state.randao_mixes[epoch % spec.preset.EPOCHS_PER_HISTORICAL_VECTOR] = mix
+
+
+def process_eth1_data(state, types, spec, body) -> None:
+    state.eth1_data_votes.append(body.eth1_data)
+    period_slots = spec.preset.EPOCHS_PER_ETH1_VOTING_PERIOD * spec.preset.SLOTS_PER_EPOCH
+    votes = [v for v in state.eth1_data_votes if v == body.eth1_data]
+    if len(votes) * 2 > period_slots:
+        state.eth1_data = body.eth1_data
+
+
+# ---------------------------------------------------------------------------
+# Operations (process_operations.rs:12)
+# ---------------------------------------------------------------------------
+
+
+def process_operations(state, types, spec, body, fork, verify_signatures, get_pubkey) -> None:
+    expected_deposits = min(
+        spec.preset.MAX_DEPOSITS,
+        state.eth1_data.deposit_count - state.eth1_deposit_index,
+    )
+    _require(
+        len(body.deposits) == expected_deposits,
+        f"expected {expected_deposits} deposits, block has {len(body.deposits)}",
+    )
+
+    for ps in body.proposer_slashings:
+        process_proposer_slashing(state, types, spec, ps, fork, verify_signatures, get_pubkey)
+    for asl in body.attester_slashings:
+        process_attester_slashing(state, types, spec, asl, fork, verify_signatures, get_pubkey)
+    for att in body.attestations:
+        process_attestation(state, types, spec, att, fork, verify_signatures, get_pubkey)
+    for dep in body.deposits:
+        process_deposit(state, types, spec, dep, fork)
+    for exit_ in body.voluntary_exits:
+        process_voluntary_exit(state, types, spec, exit_, verify_signatures, get_pubkey)
+    if ForkName.ge(fork, ForkName.CAPELLA):
+        for change in body.bls_to_execution_changes:
+            process_bls_to_execution_change(
+                state, types, spec, change, verify_signatures
+            )
+
+
+# -- attestations ------------------------------------------------------------
+
+
+def get_indexed_attestation(state, types, spec, attestation):
+    committee = h.get_beacon_committee(
+        state, spec, attestation.data.slot, attestation.data.index
+    )
+    bits = attestation.aggregation_bits
+    _require(len(bits) == len(committee), "aggregation bits length != committee size")
+    indices = sorted(i for i, bit in zip(committee, bits) if bit)
+    return types.IndexedAttestation(
+        attesting_indices=indices,
+        data=attestation.data,
+        signature=attestation.signature,
+    )
+
+
+def is_valid_indexed_attestation(
+    state, types, spec, indexed, verify_signatures, get_pubkey
+) -> bool:
+    indices = list(indexed.attesting_indices)
+    if not indices or indices != sorted(set(indices)):
+        return False
+    if verify_signatures is VerifySignatures.TRUE:
+        try:
+            sig_set = sigsets.indexed_attestation_signature_set(
+                state, types, spec, indexed, get_pubkey
+            )
+        except sigsets.SignatureSetError:
+            return False
+        return bls.verify_signature_sets([sig_set])
+    return True
+
+
+def get_attestation_participation_flag_indices(state, spec, data, inclusion_delay: int):
+    justified = (
+        state.current_justified_checkpoint
+        if data.target.epoch == h.get_current_epoch(state, spec)
+        else state.previous_justified_checkpoint
+    )
+    is_matching_source = data.source == justified
+    _require(is_matching_source, "attestation source does not match justified")
+    is_matching_target = is_matching_source and data.target.root == h.get_block_root(
+        state, spec, data.target.epoch
+    )
+    is_matching_head = (
+        is_matching_target
+        and data.beacon_block_root == h.get_block_root_at_slot(state, spec, data.slot)
+    )
+    flags = []
+    import math
+
+    if is_matching_source and inclusion_delay <= int(
+        math.isqrt(spec.preset.SLOTS_PER_EPOCH)
+    ):
+        flags.append(TIMELY_SOURCE_FLAG_INDEX)
+    if is_matching_target and inclusion_delay <= spec.preset.SLOTS_PER_EPOCH:
+        flags.append(TIMELY_TARGET_FLAG_INDEX)
+    if is_matching_head and inclusion_delay == spec.min_attestation_inclusion_delay:
+        flags.append(TIMELY_HEAD_FLAG_INDEX)
+    return flags
+
+
+def get_base_reward_per_increment(state, spec) -> int:
+    import math
+
+    return (
+        spec.effective_balance_increment
+        * spec.base_reward_factor
+        // math.isqrt(h.get_total_active_balance(state, spec))
+    )
+
+
+def get_base_reward(state, spec, index: int) -> int:
+    increments = (
+        state.validators[index].effective_balance // spec.effective_balance_increment
+    )
+    return increments * get_base_reward_per_increment(state, spec)
+
+
+def process_attestation(state, types, spec, attestation, fork, verify_signatures, get_pubkey) -> None:
+    data = attestation.data
+    cur = h.get_current_epoch(state, spec)
+    prev = h.get_previous_epoch(state, spec)
+    _require(data.target.epoch in (cur, prev), "attestation target epoch out of range")
+    _require(
+        data.target.epoch == spec.epoch_at_slot(data.slot),
+        "target epoch != slot epoch",
+    )
+    _require(
+        data.slot + spec.min_attestation_inclusion_delay <= state.slot,
+        "attestation too new",
+    )
+    if not ForkName.ge(fork, ForkName.DENEB):
+        _require(
+            state.slot <= data.slot + spec.preset.SLOTS_PER_EPOCH,
+            "attestation too old",
+        )
+    _require(
+        data.index < h.get_committee_count_per_slot(state, spec, data.target.epoch),
+        "committee index out of range",
+    )
+
+    indexed = get_indexed_attestation(state, types, spec, attestation)
+    _require(
+        is_valid_indexed_attestation(
+            state, types, spec, indexed, verify_signatures, get_pubkey
+        ),
+        "invalid indexed attestation",
+    )
+
+    inclusion_delay = state.slot - data.slot
+    flags = get_attestation_participation_flag_indices(state, spec, data, inclusion_delay)
+    participation = (
+        state.current_epoch_participation
+        if data.target.epoch == cur
+        else state.previous_epoch_participation
+    )
+    base_reward_per_increment = get_base_reward_per_increment(state, spec)
+    proposer_reward_numerator = 0
+    for index in indexed.attesting_indices:
+        for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+            if flag_index in flags and not (participation[index] >> flag_index) & 1:
+                participation[index] |= 1 << flag_index
+                proposer_reward_numerator += get_base_reward(state, spec, index) * weight
+    proposer_reward_denominator = (
+        (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT) * WEIGHT_DENOMINATOR // PROPOSER_WEIGHT
+    )
+    h.increase_balance(
+        state,
+        h.get_beacon_proposer_index(state, spec),
+        proposer_reward_numerator // proposer_reward_denominator,
+    )
+
+
+# -- slashings ---------------------------------------------------------------
+
+
+def is_slashable_attestation_data(data1, data2) -> bool:
+    return (data1 != data2 and data1.target.epoch == data2.target.epoch) or (
+        data1.source.epoch < data2.source.epoch
+        and data2.target.epoch < data1.target.epoch
+    )
+
+
+def process_proposer_slashing(state, types, spec, slashing, fork, verify_signatures, get_pubkey) -> None:
+    h1 = slashing.signed_header_1.message
+    h2 = slashing.signed_header_2.message
+    _require(h1.slot == h2.slot, "proposer slashing: slots differ")
+    _require(h1.proposer_index == h2.proposer_index, "proposer slashing: proposers differ")
+    _require(h1 != h2, "proposer slashing: identical headers")
+    proposer = state.validators[h1.proposer_index]
+    _require(
+        h.is_slashable_validator(proposer, h.get_current_epoch(state, spec)),
+        "proposer not slashable",
+    )
+    if verify_signatures is VerifySignatures.TRUE:
+        for s in sigsets.proposer_slashing_signature_sets(
+            state, types, spec, slashing, get_pubkey
+        ):
+            _verify_set(s, verify_signatures)
+    h.slash_validator(state, types, spec, h1.proposer_index, fork=fork)
+
+
+def process_attester_slashing(state, types, spec, slashing, fork, verify_signatures, get_pubkey) -> None:
+    a1, a2 = slashing.attestation_1, slashing.attestation_2
+    _require(
+        is_slashable_attestation_data(a1.data, a2.data), "attestations not slashable"
+    )
+    for att in (a1, a2):
+        _require(
+            is_valid_indexed_attestation(
+                state, types, spec, att, verify_signatures, get_pubkey
+            ),
+            "invalid indexed attestation in slashing",
+        )
+    slashed_any = False
+    cur = h.get_current_epoch(state, spec)
+    for index in sorted(
+        set(a1.attesting_indices) & set(a2.attesting_indices)
+    ):
+        if h.is_slashable_validator(state.validators[index], cur):
+            h.slash_validator(state, types, spec, index, fork=fork)
+            slashed_any = True
+    _require(slashed_any, "no validator slashed")
+
+
+# -- deposits ----------------------------------------------------------------
+
+
+def is_valid_merkle_branch(leaf: bytes, branch, depth: int, index: int, root: bytes) -> bool:
+    import hashlib
+
+    value = leaf
+    for i in range(depth):
+        if (index >> i) & 1:
+            value = hashlib.sha256(branch[i] + value).digest()
+        else:
+            value = hashlib.sha256(value + branch[i]).digest()
+    return value == root
+
+
+def get_validator_from_deposit(types, spec, pubkey, withdrawal_credentials, amount):
+    effective = min(
+        amount - amount % spec.effective_balance_increment, spec.max_effective_balance
+    )
+    return types.Validator(
+        pubkey=pubkey,
+        withdrawal_credentials=withdrawal_credentials,
+        effective_balance=effective,
+        slashed=False,
+        activation_eligibility_epoch=FAR_FUTURE_EPOCH,
+        activation_epoch=FAR_FUTURE_EPOCH,
+        exit_epoch=FAR_FUTURE_EPOCH,
+        withdrawable_epoch=FAR_FUTURE_EPOCH,
+    )
+
+
+def apply_deposit(state, types, spec, pubkey, withdrawal_credentials, amount, signature,
+                  verify_signature: bool = True) -> None:
+    pubkeys = [bytes(v.pubkey) for v in state.validators]
+    if bytes(pubkey) not in pubkeys:
+        if verify_signature:
+            try:
+                dep_data = types.DepositData(
+                    pubkey=pubkey,
+                    withdrawal_credentials=withdrawal_credentials,
+                    amount=amount,
+                    signature=signature,
+                )
+                sig_set = sigsets.deposit_signature_set(types, spec, dep_data)
+                if not bls.verify_signature_sets([sig_set]):
+                    return  # invalid PoP: deposit is skipped, not an error
+            except (sigsets.SignatureSetError, bls.BlsError):
+                return
+        state.validators.append(
+            get_validator_from_deposit(
+                types, spec, pubkey, withdrawal_credentials, amount
+            )
+        )
+        state.balances.append(amount)
+        # altair+ accounting lists grow with the registry
+        state.previous_epoch_participation.append(0)
+        state.current_epoch_participation.append(0)
+        state.inactivity_scores.append(0)
+    else:
+        index = pubkeys.index(bytes(pubkey))
+        h.increase_balance(state, index, amount)
+
+
+def process_deposit(state, types, spec, deposit, fork) -> None:
+    _require(
+        is_valid_merkle_branch(
+            types.DepositData.hash_tree_root(deposit.data),
+            [bytes(p) for p in deposit.proof],
+            33,  # DEPOSIT_CONTRACT_TREE_DEPTH + 1 (mix-in length)
+            state.eth1_deposit_index,
+            state.eth1_data.deposit_root,
+        ),
+        "invalid deposit merkle proof",
+    )
+    state.eth1_deposit_index += 1
+    apply_deposit(
+        state, types, spec,
+        deposit.data.pubkey, deposit.data.withdrawal_credentials,
+        deposit.data.amount, deposit.data.signature,
+    )
+
+
+# -- exits -------------------------------------------------------------------
+
+
+def process_voluntary_exit(state, types, spec, signed_exit, verify_signatures, get_pubkey) -> None:
+    exit_msg = signed_exit.message
+    v = state.validators[exit_msg.validator_index]
+    cur = h.get_current_epoch(state, spec)
+    _require(h.is_active_validator(v, cur), "exiting validator not active")
+    _require(v.exit_epoch == FAR_FUTURE_EPOCH, "validator already exiting")
+    _require(cur >= exit_msg.epoch, "exit epoch in the future")
+    _require(
+        cur >= v.activation_epoch + spec.shard_committee_period,
+        "validator too young to exit",
+    )
+    if verify_signatures is VerifySignatures.TRUE:
+        _verify_set(
+            sigsets.voluntary_exit_signature_set(
+                state, types, spec, signed_exit, get_pubkey
+            ),
+            verify_signatures,
+        )
+    h.initiate_validator_exit(state, spec, exit_msg.validator_index)
+
+
+def process_bls_to_execution_change(state, types, spec, signed_change, verify_signatures) -> None:
+    import hashlib
+
+    change = signed_change.message
+    _require(change.validator_index < len(state.validators), "unknown validator")
+    v = state.validators[change.validator_index]
+    creds = bytes(v.withdrawal_credentials)
+    _require(creds[:1] == b"\x00", "not BLS withdrawal credentials")
+    _require(
+        creds[1:] == hashlib.sha256(bytes(change.from_bls_pubkey)).digest()[1:],
+        "withdrawal credentials do not match BLS pubkey",
+    )
+    if verify_signatures is VerifySignatures.TRUE:
+        _verify_set(
+            sigsets.bls_execution_change_signature_set(
+                state, types, spec, signed_change
+            ),
+            verify_signatures,
+        )
+    v.withdrawal_credentials = (
+        b"\x01" + bytes(11) + bytes(change.to_execution_address)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Sync aggregate (altair)
+# ---------------------------------------------------------------------------
+
+
+def process_sync_aggregate(state, types, spec, sync_aggregate, verify_signatures, get_pubkey) -> None:
+    committee_pubkeys = list(state.current_sync_committee.pubkeys)
+    participants = [
+        pk
+        for pk, bit in zip(committee_pubkeys, sync_aggregate.sync_committee_bits)
+        if bit
+    ]
+    if verify_signatures is VerifySignatures.TRUE:
+        prev_slot = max(state.slot, 1) - 1
+        block_root = h.get_block_root_at_slot(state, spec, prev_slot)
+        # Resolve pubkeys by bytes (committee members may repeat).
+        keys = []
+        ok = True
+        for pk_bytes in participants:
+            try:
+                keys.append(bls.PublicKey.from_bytes(bytes(pk_bytes)))
+            except bls.BlsError:
+                ok = False
+                break
+        sig = bls.Signature.from_bytes(
+            bytes(sync_aggregate.sync_committee_signature), subgroup_check=False
+        )
+        if keys:
+            from lighthouse_tpu.types.spec import DOMAIN_SYNC_COMMITTEE
+            from lighthouse_tpu.types.spec import get_domain as _get_domain
+
+            domain = _get_domain(
+                spec, DOMAIN_SYNC_COMMITTEE, spec.epoch_at_slot(prev_slot),
+                state.fork.current_version, state.fork.previous_version,
+                state.fork.epoch, state.genesis_validators_root,
+            )
+            from lighthouse_tpu.types.spec import compute_signing_root
+
+            message = compute_signing_root(block_root, ssz.Bytes32, domain)
+            sig_set = bls.SignatureSet(
+                signature=sig, signing_keys=keys, message=message
+            )
+            _require(
+                ok and bls.verify_signature_sets([sig_set]),
+                "sync aggregate signature invalid",
+            )
+        else:
+            _require(sig.point is None, "non-empty signature with no participants")
+
+    # Rewards
+    total_active_increments = (
+        h.get_total_active_balance(state, spec) // spec.effective_balance_increment
+    )
+    total_base_rewards = get_base_reward_per_increment(state, spec) * total_active_increments
+    max_participant_rewards = (
+        total_base_rewards * SYNC_REWARD_WEIGHT
+        // WEIGHT_DENOMINATOR
+        // spec.preset.SLOTS_PER_EPOCH
+    )
+    participant_reward = max_participant_rewards // spec.preset.SYNC_COMMITTEE_SIZE
+    proposer_reward = (
+        participant_reward * PROPOSER_WEIGHT // (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
+    )
+    proposer_index = h.get_beacon_proposer_index(state, spec)
+
+    pubkey_to_index = {bytes(v.pubkey): i for i, v in enumerate(state.validators)}
+    for pk_bytes, bit in zip(committee_pubkeys, sync_aggregate.sync_committee_bits):
+        index = pubkey_to_index[bytes(pk_bytes)]
+        if bit:
+            h.increase_balance(state, index, participant_reward)
+            h.increase_balance(state, proposer_index, proposer_reward)
+        else:
+            h.decrease_balance(state, index, participant_reward)
+
+
+# ---------------------------------------------------------------------------
+# Execution payload + withdrawals (bellatrix/capella)
+# ---------------------------------------------------------------------------
+
+
+def process_execution_payload(state, types, spec, body, fork) -> None:
+    """Spec checks minus the actual EL validity call — `notify_new_payload`
+    is the chain layer's job (execution_layer/src/lib.rs:1324), behind the
+    mock-EL seam in tests."""
+    payload = body.execution_payload
+    _require(
+        bytes(payload.parent_hash) == bytes(state.latest_execution_payload_header.block_hash),
+        "payload parent hash mismatch",
+    )
+    _require(
+        bytes(payload.prev_randao)
+        == h.get_randao_mix(state, spec, h.get_current_epoch(state, spec)),
+        "payload prev_randao mismatch",
+    )
+    genesis_time = state.genesis_time
+    _require(
+        payload.timestamp == genesis_time + state.slot * spec.seconds_per_slot,
+        "payload timestamp mismatch",
+    )
+
+    header_cls = {
+        ForkName.BELLATRIX: types.ExecutionPayloadHeaderBellatrix,
+        ForkName.CAPELLA: types.ExecutionPayloadHeaderCapella,
+        ForkName.DENEB: types.ExecutionPayloadHeaderDeneb,
+    }[fork]
+    tx_list = ssz.List(types.Transaction, spec.preset.MAX_TRANSACTIONS_PER_PAYLOAD)
+    fields = dict(
+        parent_hash=payload.parent_hash,
+        fee_recipient=payload.fee_recipient,
+        state_root=payload.state_root,
+        receipts_root=payload.receipts_root,
+        logs_bloom=payload.logs_bloom,
+        prev_randao=payload.prev_randao,
+        block_number=payload.block_number,
+        gas_limit=payload.gas_limit,
+        gas_used=payload.gas_used,
+        timestamp=payload.timestamp,
+        extra_data=payload.extra_data,
+        base_fee_per_gas=payload.base_fee_per_gas,
+        block_hash=payload.block_hash,
+        transactions_root=tx_list.hash_tree_root(payload.transactions),
+    )
+    if ForkName.ge(fork, ForkName.CAPELLA):
+        wlist = ssz.List(types.Withdrawal, spec.preset.MAX_WITHDRAWALS_PER_PAYLOAD)
+        fields["withdrawals_root"] = wlist.hash_tree_root(payload.withdrawals)
+    if ForkName.ge(fork, ForkName.DENEB):
+        fields["blob_gas_used"] = payload.blob_gas_used
+        fields["excess_blob_gas"] = payload.excess_blob_gas
+    state.latest_execution_payload_header = header_cls(**fields)
+
+
+def has_eth1_withdrawal_credential(v) -> bool:
+    return bytes(v.withdrawal_credentials)[:1] == b"\x01"
+
+
+def is_fully_withdrawable_validator(v, balance: int, epoch: int) -> bool:
+    return (
+        has_eth1_withdrawal_credential(v)
+        and v.withdrawable_epoch <= epoch
+        and balance > 0
+    )
+
+
+def is_partially_withdrawable_validator(v, balance: int, spec) -> bool:
+    return (
+        has_eth1_withdrawal_credential(v)
+        and v.effective_balance == spec.max_effective_balance
+        and balance > spec.max_effective_balance
+    )
+
+
+def get_expected_withdrawals(state, types, spec):
+    epoch = h.get_current_epoch(state, spec)
+    withdrawal_index = state.next_withdrawal_index
+    validator_index = state.next_withdrawal_validator_index
+    withdrawals = []
+    bound = min(len(state.validators), spec.preset.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP)
+    for _ in range(bound):
+        v = state.validators[validator_index]
+        balance = state.balances[validator_index]
+        if is_fully_withdrawable_validator(v, balance, epoch):
+            withdrawals.append(
+                types.Withdrawal(
+                    index=withdrawal_index,
+                    validator_index=validator_index,
+                    address=bytes(v.withdrawal_credentials)[12:],
+                    amount=balance,
+                )
+            )
+            withdrawal_index += 1
+        elif is_partially_withdrawable_validator(v, balance, spec):
+            withdrawals.append(
+                types.Withdrawal(
+                    index=withdrawal_index,
+                    validator_index=validator_index,
+                    address=bytes(v.withdrawal_credentials)[12:],
+                    amount=balance - spec.max_effective_balance,
+                )
+            )
+            withdrawal_index += 1
+        if len(withdrawals) == spec.preset.MAX_WITHDRAWALS_PER_PAYLOAD:
+            break
+        validator_index = (validator_index + 1) % len(state.validators)
+    return withdrawals
+
+
+def process_withdrawals(state, types, spec, payload, fork) -> None:
+    if not ForkName.ge(fork, ForkName.CAPELLA):
+        return
+    expected = get_expected_withdrawals(state, types, spec)
+    _require(
+        list(payload.withdrawals) == expected, "withdrawals do not match expected"
+    )
+    for w in expected:
+        h.decrease_balance(state, w.validator_index, w.amount)
+    if expected:
+        state.next_withdrawal_index = expected[-1].index + 1
+    if len(expected) == spec.preset.MAX_WITHDRAWALS_PER_PAYLOAD:
+        state.next_withdrawal_validator_index = (
+            expected[-1].validator_index + 1
+        ) % len(state.validators)
+    else:
+        state.next_withdrawal_validator_index = (
+            state.next_withdrawal_validator_index
+            + spec.preset.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP
+        ) % len(state.validators)

@@ -1,0 +1,176 @@
+"""BlockSignatureVerifier — accumulate every signature set of a block, then
+verify in ONE device batch.
+
+Mirror of consensus/state_processing/src/per_block_processing/
+block_signature_verifier.rs:74-176: `include_all_signatures` gathers the
+proposal + randao + every operation's sets; the reference then rayon-chunks
+across cores (:396-404) — here the whole accumulation goes to the backend in
+one `verify_signature_sets` call (the TPU shards the batch axis instead,
+SURVEY.md §2.8 DP row).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from lighthouse_tpu.crypto.bls import api as bls
+from lighthouse_tpu.types.spec import ForkName
+
+from . import block_processing as bp
+from . import signature_sets as ss
+
+
+class BlockSignatureVerifierError(Exception):
+    pass
+
+
+class BlockSignatureVerifier:
+    def __init__(self, state, types, spec, get_pubkey=None):
+        self.state = state
+        self.types = types
+        self.spec = spec
+        self.get_pubkey = get_pubkey or bp.default_pubkey_getter(state)
+        self.sets: List[bls.SignatureSet] = []
+
+    # -- accumulation (include_* mirror block_signature_verifier.rs) --------
+
+    def include_block_proposal(self, signed_block, fork: str) -> None:
+        self.sets.append(
+            ss.block_proposal_signature_set(
+                self.state, self.types, self.spec, signed_block, fork, self.get_pubkey
+            )
+        )
+
+    def include_randao_reveal(self, block) -> None:
+        epoch = self.spec.epoch_at_slot(block.slot)
+        self.sets.append(
+            ss.randao_signature_set(
+                self.state, self.types, self.spec, block.proposer_index, epoch,
+                block.body.randao_reveal, self.get_pubkey,
+            )
+        )
+
+    def include_attestations(self, block) -> None:
+        for att in block.body.attestations:
+            indexed = bp.get_indexed_attestation(
+                self.state, self.types, self.spec, att
+            )
+            if not indexed.attesting_indices:
+                raise BlockSignatureVerifierError("empty attestation")
+            self.sets.append(
+                ss.indexed_attestation_signature_set(
+                    self.state, self.types, self.spec, indexed, self.get_pubkey
+                )
+            )
+
+    def include_proposer_slashings(self, block) -> None:
+        for sl in block.body.proposer_slashings:
+            self.sets.extend(
+                ss.proposer_slashing_signature_sets(
+                    self.state, self.types, self.spec, sl, self.get_pubkey
+                )
+            )
+
+    def include_attester_slashings(self, block) -> None:
+        for sl in block.body.attester_slashings:
+            self.sets.extend(
+                ss.attester_slashing_signature_sets(
+                    self.state, self.types, self.spec, sl, self.get_pubkey
+                )
+            )
+
+    def include_exits(self, block) -> None:
+        for e in block.body.voluntary_exits:
+            self.sets.append(
+                ss.voluntary_exit_signature_set(
+                    self.state, self.types, self.spec, e, self.get_pubkey
+                )
+            )
+
+    def include_bls_to_execution_changes(self, block, fork: str) -> None:
+        if not ForkName.ge(fork, ForkName.CAPELLA):
+            return
+        for c in block.body.bls_to_execution_changes:
+            self.sets.append(
+                ss.bls_execution_change_signature_set(
+                    self.state, self.types, self.spec, c
+                )
+            )
+
+    def include_sync_aggregate(self, block) -> None:
+        from . import helpers as h
+
+        agg = block.body.sync_aggregate
+        committee = list(self.state.current_sync_committee.pubkeys)
+        participant_pks = [
+            bytes(pk) for pk, bit in zip(committee, agg.sync_committee_bits) if bit
+        ]
+        prev_slot = max(block.slot, 1) - 1
+        block_root = h.get_block_root_at_slot(self.state, self.spec, prev_slot)
+        sig = bls.Signature.from_bytes(
+            bytes(agg.sync_committee_signature), subgroup_check=False
+        )
+        if not participant_pks:
+            if sig.point is not None:
+                raise BlockSignatureVerifierError(
+                    "sync aggregate signature without participants"
+                )
+            return
+        keys = [bls.PublicKey.from_bytes(pk) for pk in participant_pks]
+        s = ss.sync_committee_message_set  # noqa: F841 (same message shape)
+        from lighthouse_tpu.types import ssz
+        from lighthouse_tpu.types.spec import (
+            DOMAIN_SYNC_COMMITTEE,
+            compute_signing_root,
+            get_domain,
+        )
+
+        domain = get_domain(
+            self.spec, DOMAIN_SYNC_COMMITTEE, self.spec.epoch_at_slot(prev_slot),
+            self.state.fork.current_version, self.state.fork.previous_version,
+            self.state.fork.epoch, self.state.genesis_validators_root,
+        )
+        message = compute_signing_root(block_root, ssz.Bytes32, domain)
+        self.sets.append(
+            bls.SignatureSet(signature=sig, signing_keys=keys, message=message)
+        )
+
+    def include_all_signatures(self, signed_block, fork: str) -> None:
+        self.include_block_proposal(signed_block, fork)
+        self.include_all_signatures_except_proposal(signed_block.message, fork)
+
+    def include_all_signatures_except_proposal(self, block, fork: str) -> None:
+        self.include_randao_reveal(block)
+        self.include_proposer_slashings(block)
+        self.include_attester_slashings(block)
+        self.include_attestations(block)
+        # NOTE: deposits are NOT included — deposit signatures are verified
+        # individually during processing because an invalid deposit PoP skips
+        # the deposit rather than invalidating the block
+        # (block_signature_verifier.rs excludes them identically).
+        self.include_exits(block)
+        self.include_bls_to_execution_changes(block, fork)
+        self.include_sync_aggregate(block)
+
+    # -- verification -------------------------------------------------------
+
+    def verify(self, backend: Optional[str] = None) -> bool:
+        if not self.sets:
+            return True
+        return bls.verify_signature_sets(self.sets, backend=backend)
+
+
+def signature_verify_chain_segment(
+    states_and_blocks, types, spec, backend: Optional[str] = None
+) -> bool:
+    """One bulk BLS pass over a whole segment of blocks (reference
+    block_verification.rs:572,620-626 — BLS hot loop #3, the block-replay
+    BASELINE config). `states_and_blocks`: [(pre_state, signed_block, fork)]."""
+    all_sets: List[bls.SignatureSet] = []
+    for state, signed_block, fork in states_and_blocks:
+        v = BlockSignatureVerifier(state, types, spec)
+        v.include_all_signatures(signed_block, fork)
+        all_sets.extend(v.sets)
+    if not all_sets:
+        return True
+    return bls.verify_signature_sets(all_sets, backend=backend)

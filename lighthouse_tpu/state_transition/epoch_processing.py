@@ -1,0 +1,373 @@
+"""process_epoch — altair+ accounting (reference per_epoch_processing.rs:31,
+altair variant).
+
+Order (spec): justification/finalization, inactivity updates,
+rewards/penalties, registry updates, slashings, eth1-data reset,
+effective-balance updates, slashings reset, randao reset, historical
+summaries, participation rotation, sync-committee rotation.
+"""
+
+from __future__ import annotations
+
+from lighthouse_tpu.types.spec import (
+    FAR_FUTURE_EPOCH,
+    GENESIS_EPOCH,
+    PARTICIPATION_FLAG_WEIGHTS,
+    TIMELY_HEAD_FLAG_INDEX,
+    TIMELY_SOURCE_FLAG_INDEX,
+    TIMELY_TARGET_FLAG_INDEX,
+    WEIGHT_DENOMINATOR,
+    ForkName,
+)
+
+from . import helpers as h
+
+
+def get_unslashed_participating_indices(state, spec, flag_index: int, epoch: int):
+    cur = h.get_current_epoch(state, spec)
+    assert epoch in (cur, h.get_previous_epoch(state, spec))
+    participation = (
+        state.current_epoch_participation
+        if epoch == cur
+        else state.previous_epoch_participation
+    )
+    return {
+        i
+        for i in h.get_active_validator_indices(state, epoch)
+        if (participation[i] >> flag_index) & 1 and not state.validators[i].slashed
+    }
+
+
+# --- justification / finalization ------------------------------------------
+
+
+def process_justification_and_finalization(state, spec) -> None:
+    if h.get_current_epoch(state, spec) <= GENESIS_EPOCH + 1:
+        return
+    prev_targets = get_unslashed_participating_indices(
+        state, spec, TIMELY_TARGET_FLAG_INDEX, h.get_previous_epoch(state, spec)
+    )
+    cur_targets = get_unslashed_participating_indices(
+        state, spec, TIMELY_TARGET_FLAG_INDEX, h.get_current_epoch(state, spec)
+    )
+    total = h.get_total_active_balance(state, spec)
+    prev_bal = h.get_total_balance(state, spec, prev_targets)
+    cur_bal = h.get_total_balance(state, spec, cur_targets)
+    weigh_justification_and_finalization(state, spec, total, prev_bal, cur_bal)
+
+
+def weigh_justification_and_finalization(
+    state, spec, total_active_balance, previous_epoch_target_balance,
+    current_epoch_target_balance,
+) -> None:
+    from lighthouse_tpu.types.containers import make_types
+
+    types = make_types(spec.preset)
+    prev = h.get_previous_epoch(state, spec)
+    cur = h.get_current_epoch(state, spec)
+    old_prev_justified = state.previous_justified_checkpoint
+    old_cur_justified = state.current_justified_checkpoint
+
+    state.previous_justified_checkpoint = state.current_justified_checkpoint
+    bits = list(state.justification_bits)
+    bits = [False] + bits[:3]
+    if previous_epoch_target_balance * 3 >= total_active_balance * 2:
+        state.current_justified_checkpoint = types.Checkpoint(
+            epoch=prev, root=h.get_block_root(state, spec, prev)
+        )
+        bits[1] = True
+    if current_epoch_target_balance * 3 >= total_active_balance * 2:
+        state.current_justified_checkpoint = types.Checkpoint(
+            epoch=cur, root=h.get_block_root(state, spec, cur)
+        )
+        bits[0] = True
+    state.justification_bits = bits
+
+    # Finalization rules (234/23/123/12)
+    if all(bits[1:4]) and old_prev_justified.epoch + 3 == cur:
+        state.finalized_checkpoint = old_prev_justified
+    if all(bits[1:3]) and old_prev_justified.epoch + 2 == cur:
+        state.finalized_checkpoint = old_prev_justified
+    if all(bits[0:3]) and old_cur_justified.epoch + 2 == cur:
+        state.finalized_checkpoint = old_cur_justified
+    if all(bits[0:2]) and old_cur_justified.epoch + 1 == cur:
+        state.finalized_checkpoint = old_cur_justified
+
+
+# --- inactivity -------------------------------------------------------------
+
+
+def is_in_inactivity_leak(state, spec) -> bool:
+    return (
+        h.get_previous_epoch(state, spec) - state.finalized_checkpoint.epoch
+        > spec.preset.MIN_EPOCHS_TO_INACTIVITY_PENALTY
+    )
+
+
+def process_inactivity_updates(state, spec) -> None:
+    if h.get_current_epoch(state, spec) == GENESIS_EPOCH:
+        return
+    prev = h.get_previous_epoch(state, spec)
+    prev_targets = get_unslashed_participating_indices(
+        state, spec, TIMELY_TARGET_FLAG_INDEX, prev
+    )
+    leaking = is_in_inactivity_leak(state, spec)
+    for i in h.get_active_validator_indices(state, prev):
+        if i in prev_targets:
+            state.inactivity_scores[i] -= min(1, state.inactivity_scores[i])
+        else:
+            state.inactivity_scores[i] += spec.inactivity_score_bias
+        if not leaking:
+            state.inactivity_scores[i] -= min(
+                spec.inactivity_score_recovery_rate, state.inactivity_scores[i]
+            )
+
+
+# --- rewards & penalties ----------------------------------------------------
+
+
+def get_flag_index_deltas(state, spec, flag_index: int):
+    """Returns (rewards, penalties) arrays for one participation flag."""
+    n = len(state.validators)
+    rewards = [0] * n
+    penalties = [0] * n
+    prev = h.get_previous_epoch(state, spec)
+    unslashed = get_unslashed_participating_indices(state, spec, flag_index, prev)
+    weight = PARTICIPATION_FLAG_WEIGHTS[flag_index]
+    unslashed_balance = h.get_total_balance(state, spec, unslashed)
+    unslashed_increments = unslashed_balance // spec.effective_balance_increment
+    active_increments = (
+        h.get_total_active_balance(state, spec) // spec.effective_balance_increment
+    )
+    leaking = is_in_inactivity_leak(state, spec)
+    for i in get_eligible_validator_indices(state, spec):
+        from .block_processing import get_base_reward
+
+        base = get_base_reward(state, spec, i)
+        if i in unslashed:
+            if not leaking:
+                numerator = base * weight * unslashed_increments
+                rewards[i] += numerator // (active_increments * WEIGHT_DENOMINATOR)
+        elif flag_index != TIMELY_HEAD_FLAG_INDEX:
+            penalties[i] += base * weight // WEIGHT_DENOMINATOR
+    return rewards, penalties
+
+
+def get_eligible_validator_indices(state, spec):
+    prev = h.get_previous_epoch(state, spec)
+    return [
+        i
+        for i, v in enumerate(state.validators)
+        if h.is_active_validator(v, prev)
+        or (v.slashed and prev + 1 < v.withdrawable_epoch)
+    ]
+
+
+def get_inactivity_penalty_deltas(state, spec, fork: str):
+    n = len(state.validators)
+    penalties = [0] * n
+    prev = h.get_previous_epoch(state, spec)
+    matching_targets = get_unslashed_participating_indices(
+        state, spec, TIMELY_TARGET_FLAG_INDEX, prev
+    )
+    if ForkName.ge(fork, ForkName.BELLATRIX):
+        quotient = spec.inactivity_penalty_quotient_bellatrix
+    else:
+        quotient = spec.inactivity_penalty_quotient_altair
+    for i in get_eligible_validator_indices(state, spec):
+        if i not in matching_targets:
+            penalty_numerator = (
+                state.validators[i].effective_balance * state.inactivity_scores[i]
+            )
+            penalties[i] += penalty_numerator // (
+                spec.inactivity_score_bias * quotient
+            )
+    return penalties
+
+
+def process_rewards_and_penalties(state, spec, fork: str) -> None:
+    if h.get_current_epoch(state, spec) == GENESIS_EPOCH:
+        return
+    n = len(state.validators)
+    total_rewards = [0] * n
+    total_penalties = [0] * n
+    for flag_index in range(len(PARTICIPATION_FLAG_WEIGHTS)):
+        rewards, penalties = get_flag_index_deltas(state, spec, flag_index)
+        for i in range(n):
+            total_rewards[i] += rewards[i]
+            total_penalties[i] += penalties[i]
+    for i, p in enumerate(get_inactivity_penalty_deltas(state, spec, fork)):
+        total_penalties[i] += p
+    for i in range(n):
+        h.increase_balance(state, i, total_rewards[i])
+        h.decrease_balance(state, i, total_penalties[i])
+
+
+# --- registry / slashings / resets -----------------------------------------
+
+
+def process_registry_updates(state, spec) -> None:
+    cur = h.get_current_epoch(state, spec)
+    for i, v in enumerate(state.validators):
+        if h.is_eligible_for_activation_queue(v, spec):
+            v.activation_eligibility_epoch = cur + 1
+        if h.is_active_validator(v, cur) and v.effective_balance <= spec.ejection_balance:
+            h.initiate_validator_exit(state, spec, i)
+
+    activation_queue = sorted(
+        [
+            i
+            for i, v in enumerate(state.validators)
+            if v.activation_eligibility_epoch <= state.finalized_checkpoint.epoch
+            and v.activation_epoch == FAR_FUTURE_EPOCH
+        ],
+        key=lambda i: (state.validators[i].activation_eligibility_epoch, i),
+    )
+    churn = h.get_validator_activation_churn_limit(state, spec)
+    for i in activation_queue[:churn]:
+        state.validators[i].activation_epoch = h.compute_activation_exit_epoch(cur, spec)
+
+
+def process_slashings(state, spec, fork: str) -> None:
+    epoch = h.get_current_epoch(state, spec)
+    total = h.get_total_active_balance(state, spec)
+    total_slashings = sum(state.slashings)
+    if ForkName.ge(fork, ForkName.BELLATRIX):
+        mult = spec.proportional_slashing_multiplier_bellatrix
+    elif fork == ForkName.ALTAIR:
+        mult = spec.proportional_slashing_multiplier_altair
+    else:
+        mult = spec.proportional_slashing_multiplier
+    adjusted = min(total_slashings * mult, total)
+    for i, v in enumerate(state.validators):
+        if (
+            v.slashed
+            and epoch + spec.preset.EPOCHS_PER_SLASHINGS_VECTOR // 2
+            == v.withdrawable_epoch
+        ):
+            increment = spec.effective_balance_increment
+            penalty_numerator = v.effective_balance // increment * adjusted
+            penalty = penalty_numerator // total * increment
+            h.decrease_balance(state, i, penalty)
+
+
+def process_eth1_data_reset(state, spec) -> None:
+    next_epoch = h.get_current_epoch(state, spec) + 1
+    if next_epoch % spec.preset.EPOCHS_PER_ETH1_VOTING_PERIOD == 0:
+        state.eth1_data_votes = []
+
+
+def process_effective_balance_updates(state, spec) -> None:
+    HYSTERESIS_QUOTIENT = 4
+    HYSTERESIS_DOWNWARD_MULTIPLIER = 1
+    HYSTERESIS_UPWARD_MULTIPLIER = 5
+    increment = spec.effective_balance_increment
+    hysteresis = increment // HYSTERESIS_QUOTIENT
+    down = hysteresis * HYSTERESIS_DOWNWARD_MULTIPLIER
+    up = hysteresis * HYSTERESIS_UPWARD_MULTIPLIER
+    for i, v in enumerate(state.validators):
+        balance = state.balances[i]
+        if balance + down < v.effective_balance or v.effective_balance + up < balance:
+            v.effective_balance = min(
+                balance - balance % increment, spec.max_effective_balance
+            )
+
+
+def process_slashings_reset(state, spec) -> None:
+    next_epoch = h.get_current_epoch(state, spec) + 1
+    state.slashings[next_epoch % spec.preset.EPOCHS_PER_SLASHINGS_VECTOR] = 0
+
+
+def process_randao_mixes_reset(state, spec) -> None:
+    cur = h.get_current_epoch(state, spec)
+    next_epoch = cur + 1
+    state.randao_mixes[
+        next_epoch % spec.preset.EPOCHS_PER_HISTORICAL_VECTOR
+    ] = h.get_randao_mix(state, spec, cur)
+
+
+def process_historical_summaries_update(state, types, spec) -> None:
+    next_epoch = h.get_current_epoch(state, spec) + 1
+    P = spec.preset
+    if next_epoch % (P.SLOTS_PER_HISTORICAL_ROOT // P.SLOTS_PER_EPOCH) == 0:
+        from lighthouse_tpu.types import ssz
+
+        roots_t = ssz.Vector(ssz.Bytes32, P.SLOTS_PER_HISTORICAL_ROOT)
+        state.historical_summaries.append(
+            types.HistoricalSummary(
+                block_summary_root=roots_t.hash_tree_root(state.block_roots),
+                state_summary_root=roots_t.hash_tree_root(state.state_roots),
+            )
+        )
+
+
+def process_participation_flag_updates(state) -> None:
+    state.previous_epoch_participation = state.current_epoch_participation
+    state.current_epoch_participation = [0] * len(state.validators)
+
+
+# --- sync committee rotation ------------------------------------------------
+
+
+def get_next_sync_committee_indices(state, spec):
+    from lighthouse_tpu.types.spec import DOMAIN_SYNC_COMMITTEE
+    import hashlib
+
+    epoch = h.get_current_epoch(state, spec) + 1
+    MAX_RANDOM_BYTE = 2**8 - 1
+    active = h.get_active_validator_indices(state, epoch)
+    seed = h.get_seed(state, spec, epoch, DOMAIN_SYNC_COMMITTEE)
+    i = 0
+    indices = []
+    while len(indices) < spec.preset.SYNC_COMMITTEE_SIZE:
+        shuffled_i = h.compute_shuffled_index(
+            i % len(active), len(active), seed, spec.preset.SHUFFLE_ROUND_COUNT
+        )
+        candidate = active[shuffled_i]
+        random_byte = hashlib.sha256(
+            seed + (i // 32).to_bytes(8, "little")
+        ).digest()[i % 32]
+        eb = state.validators[candidate].effective_balance
+        if eb * MAX_RANDOM_BYTE >= spec.max_effective_balance * random_byte:
+            indices.append(candidate)
+        i += 1
+    return indices
+
+
+def get_next_sync_committee(state, types, spec):
+    from lighthouse_tpu.crypto.bls.api import AggregatePublicKey, PublicKey
+
+    indices = get_next_sync_committee_indices(state, spec)
+    pubkeys = [state.validators[i].pubkey for i in indices]
+    agg = AggregatePublicKey.aggregate(
+        [PublicKey.from_bytes(bytes(pk)) for pk in pubkeys]
+    )
+    from lighthouse_tpu.crypto.bls import curves as oc
+
+    agg_bytes = oc.g1_to_compressed(agg.point)
+    return types.SyncCommittee(pubkeys=pubkeys, aggregate_pubkey=agg_bytes)
+
+
+def process_sync_committee_updates(state, types, spec) -> None:
+    next_epoch = h.get_current_epoch(state, spec) + 1
+    if next_epoch % spec.preset.EPOCHS_PER_SYNC_COMMITTEE_PERIOD == 0:
+        state.current_sync_committee = state.next_sync_committee
+        state.next_sync_committee = get_next_sync_committee(state, types, spec)
+
+
+# --- top level --------------------------------------------------------------
+
+
+def process_epoch(state, types, spec, fork: str) -> None:
+    process_justification_and_finalization(state, spec)
+    process_inactivity_updates(state, spec)
+    process_rewards_and_penalties(state, spec, fork)
+    process_registry_updates(state, spec)
+    process_slashings(state, spec, fork)
+    process_eth1_data_reset(state, spec)
+    process_effective_balance_updates(state, spec)
+    process_slashings_reset(state, spec)
+    process_randao_mixes_reset(state, spec)
+    process_historical_summaries_update(state, types, spec)
+    process_participation_flag_updates(state)
+    process_sync_committee_updates(state, types, spec)

@@ -1,0 +1,123 @@
+"""Genesis construction: interop (deterministic keys) + from-deposits.
+
+Mirror of the reference's genesis paths (beacon_node/genesis/src/interop.rs
+and consensus/state_processing/src/genesis.rs): the interop path builds a
+fully-active validator set from deterministic keypairs — the basis of the
+in-process test harness (test_utils.rs:326,349 uses
+generate_deterministic_keypairs the same way).
+
+Interop secret keys follow the eth2 interop standard:
+    sk_i = int_LE(sha256(uint64_LE_32(i))) mod r
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List
+
+from lighthouse_tpu.crypto.bls.api import SecretKey
+from lighthouse_tpu.crypto.bls.constants import R
+from lighthouse_tpu.types.spec import FAR_FUTURE_EPOCH, GENESIS_EPOCH, ForkName
+
+
+def interop_secret_key(index: int) -> SecretKey:
+    digest = hashlib.sha256(index.to_bytes(32, "little")).digest()
+    return SecretKey(int.from_bytes(digest, "little") % R)
+
+
+def generate_deterministic_keypairs(n: int) -> List[SecretKey]:
+    return [interop_secret_key(i) for i in range(n)]
+
+
+def bls_withdrawal_credentials(pubkey_bytes: bytes) -> bytes:
+    return b"\x00" + hashlib.sha256(pubkey_bytes).digest()[1:]
+
+
+def interop_genesis_state(
+    types, spec, keypairs: List[SecretKey], genesis_time: int = 0,
+    fork: str = ForkName.CAPELLA, eth1_block_hash: bytes = b"\x42" * 32,
+    execution_block_hash: bytes = b"\x43" * 32,
+):
+    """Build a genesis BeaconState at `fork` with every validator active.
+
+    All balances at max effective; sync committees computed from the genesis
+    randao; the execution payload header carries `execution_block_hash` so a
+    mock EL can chain from it.
+    """
+    P = spec.preset
+    state = types.BeaconState[fork]()
+    state.genesis_time = genesis_time
+    state.slot = 0
+    state.fork = types.Fork(
+        previous_version=spec.fork_version_for_name(fork),
+        current_version=spec.fork_version_for_name(fork),
+        epoch=GENESIS_EPOCH,
+    )
+    state.eth1_data = types.Eth1Data(
+        deposit_root=b"\x00" * 32,
+        deposit_count=len(keypairs),
+        block_hash=eth1_block_hash,
+    )
+    state.eth1_deposit_index = len(keypairs)
+    state.randao_mixes = [eth1_block_hash] * P.EPOCHS_PER_HISTORICAL_VECTOR
+    state.slashings = [0] * P.EPOCHS_PER_SLASHINGS_VECTOR
+    state.block_roots = [b"\x00" * 32] * P.SLOTS_PER_HISTORICAL_ROOT
+    state.state_roots = [b"\x00" * 32] * P.SLOTS_PER_HISTORICAL_ROOT
+
+    for sk in keypairs:
+        pk = sk.public_key().to_bytes()
+        state.validators.append(
+            types.Validator(
+                pubkey=pk,
+                withdrawal_credentials=bls_withdrawal_credentials(pk),
+                effective_balance=spec.max_effective_balance,
+                slashed=False,
+                activation_eligibility_epoch=GENESIS_EPOCH,
+                activation_epoch=GENESIS_EPOCH,
+                exit_epoch=FAR_FUTURE_EPOCH,
+                withdrawable_epoch=FAR_FUTURE_EPOCH,
+            )
+        )
+        state.balances.append(spec.max_effective_balance)
+        state.previous_epoch_participation.append(0)
+        state.current_epoch_participation.append(0)
+        state.inactivity_scores.append(0)
+
+    state.genesis_validators_root = _validators_root(types, spec, state)
+
+    # latest block header points at an empty body of this fork.
+    body_cls = types.BeaconBlockBody[fork]
+    state.latest_block_header = types.BeaconBlockHeader(
+        slot=0,
+        proposer_index=0,
+        parent_root=b"\x00" * 32,
+        state_root=b"\x00" * 32,  # filled by first process_slot
+        body_root=body_cls.hash_tree_root(body_cls()),
+    )
+
+    # Sync committees (altair+; all supported genesis forks are altair+).
+    from . import epoch_processing as ep
+
+    state.current_sync_committee = ep.get_next_sync_committee(state, types, spec)
+    state.next_sync_committee = ep.get_next_sync_committee(state, types, spec)
+
+    # Execution payload header (bellatrix+): a synthetic pre-genesis block.
+    if ForkName.ge(fork, ForkName.BELLATRIX):
+        header_cls = {
+            ForkName.BELLATRIX: types.ExecutionPayloadHeaderBellatrix,
+            ForkName.CAPELLA: types.ExecutionPayloadHeaderCapella,
+            ForkName.DENEB: types.ExecutionPayloadHeaderDeneb,
+        }[fork]
+        state.latest_execution_payload_header = header_cls(
+            block_hash=execution_block_hash,
+            timestamp=genesis_time,
+            prev_randao=eth1_block_hash,
+        )
+    return state
+
+
+def _validators_root(types, spec, state) -> bytes:
+    from lighthouse_tpu.types import ssz
+
+    vals_t = ssz.List(types.Validator, spec.preset.VALIDATOR_REGISTRY_LIMIT)
+    return vals_t.hash_tree_root(state.validators)

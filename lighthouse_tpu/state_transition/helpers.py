@@ -1,0 +1,319 @@
+"""Beacon-state accessors: shuffling, committees, proposers, seeds.
+
+Mirrors the reference's split between `consensus/swap_or_not_shuffle`
+(compute_shuffled_index) and the committee-cache machinery in
+`consensus/types/src/beacon_state.rs`. Pure functions over the SSZ state;
+callers keep their own caches (the beacon_chain layer holds the shuffling
+cache like the reference's shuffling_cache.rs).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Sequence
+
+from lighthouse_tpu.types.spec import (
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_BEACON_PROPOSER,
+    DOMAIN_SYNC_COMMITTEE,
+    FAR_FUTURE_EPOCH,
+    GENESIS_EPOCH,
+)
+
+
+def _sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+# --- validator predicates ---------------------------------------------------
+
+
+def is_active_validator(v, epoch: int) -> bool:
+    return v.activation_epoch <= epoch < v.exit_epoch
+
+
+def is_eligible_for_activation_queue(v, spec) -> bool:
+    return (
+        v.activation_eligibility_epoch == FAR_FUTURE_EPOCH
+        and v.effective_balance == spec.max_effective_balance
+    )
+
+
+def is_slashable_validator(v, epoch: int) -> bool:
+    return (not v.slashed) and v.activation_epoch <= epoch < v.withdrawable_epoch
+
+
+def get_active_validator_indices(state, epoch: int) -> List[int]:
+    return [i for i, v in enumerate(state.validators) if is_active_validator(v, epoch)]
+
+
+# --- epoch/slot helpers -----------------------------------------------------
+
+
+def get_current_epoch(state, spec) -> int:
+    return spec.epoch_at_slot(state.slot)
+
+
+def get_previous_epoch(state, spec) -> int:
+    cur = get_current_epoch(state, spec)
+    return cur - 1 if cur > GENESIS_EPOCH else GENESIS_EPOCH
+
+def get_block_root_at_slot(state, spec, slot: int) -> bytes:
+    if not (slot < state.slot <= slot + spec.preset.SLOTS_PER_HISTORICAL_ROOT):
+        raise ValueError("slot out of block_roots range")
+    return state.block_roots[slot % spec.preset.SLOTS_PER_HISTORICAL_ROOT]
+
+
+def get_block_root(state, spec, epoch: int) -> bytes:
+    return get_block_root_at_slot(state, spec, spec.start_slot_of_epoch(epoch))
+
+
+def get_randao_mix(state, spec, epoch: int) -> bytes:
+    return state.randao_mixes[epoch % spec.preset.EPOCHS_PER_HISTORICAL_VECTOR]
+
+
+# --- seeds & shuffling ------------------------------------------------------
+
+
+def get_seed(state, spec, epoch: int, domain_type: bytes) -> bytes:
+    mix = get_randao_mix(
+        state, spec,
+        epoch + spec.preset.EPOCHS_PER_HISTORICAL_VECTOR - spec.preset.MIN_SEED_LOOKAHEAD - 1,
+    )
+    return _sha256(domain_type + epoch.to_bytes(8, "little") + mix)
+
+
+def compute_shuffled_index(index: int, index_count: int, seed: bytes, rounds: int) -> int:
+    """Swap-or-not shuffle, single index (consensus/swap_or_not_shuffle)."""
+    assert index < index_count
+    for r in range(rounds):
+        pivot = int.from_bytes(
+            _sha256(seed + r.to_bytes(1, "little"))[:8], "little"
+        ) % index_count
+        flip = (pivot + index_count - index) % index_count
+        position = max(index, flip)
+        source = _sha256(
+            seed + r.to_bytes(1, "little") + (position // 256).to_bytes(4, "little")
+        )
+        byte = source[(position % 256) // 8]
+        if (byte >> (position % 8)) % 2:
+            index = flip
+    return index
+
+
+def compute_shuffled_list(indices: Sequence[int], seed: bytes, rounds: int) -> List[int]:
+    """Shuffle a whole list with the inverse-network trick (one pass per
+    round over all elements — the committee-cache path)."""
+    items = list(indices)
+    n = len(items)
+    if n <= 1:
+        return items
+    # Apply rounds in REVERSE to realize the forward permutation list-wise
+    # (shuffled[i] = items[compute_shuffled_index^-1(i)] equivalence).
+    for r in reversed(range(rounds)):
+        pivot = int.from_bytes(_sha256(seed + r.to_bytes(1, "little"))[:8], "little") % n
+        sources = {}
+        new_items = list(items)
+        for i in range(n):
+            flip = (pivot + n - i) % n
+            position = max(i, flip)
+            block = position // 256
+            if block not in sources:
+                sources[block] = _sha256(
+                    seed + r.to_bytes(1, "little") + block.to_bytes(4, "little")
+                )
+            byte = sources[block][(position % 256) // 8]
+            if (byte >> (position % 8)) % 2:
+                new_items[i] = items[flip]
+            else:
+                new_items[i] = items[i]
+        items = new_items
+    return items
+
+
+def compute_committee(indices: Sequence[int], seed: bytes, index: int, count: int,
+                      rounds: int) -> List[int]:
+    start = (len(indices) * index) // count
+    end = (len(indices) * (index + 1)) // count
+    shuffled = compute_shuffled_list(indices, seed, rounds)
+    return shuffled[start:end]
+
+
+# --- committees -------------------------------------------------------------
+
+
+def get_committee_count_per_slot(state, spec, epoch: int) -> int:
+    active = len(get_active_validator_indices(state, epoch))
+    P = spec.preset
+    return max(
+        1,
+        min(
+            P.MAX_COMMITTEES_PER_SLOT,
+            active // P.SLOTS_PER_EPOCH // P.TARGET_COMMITTEE_SIZE,
+        ),
+    )
+
+
+class CommitteeCache:
+    """Per-epoch committee assignment, computed once (mirrors the committee
+    cache inside the reference's BeaconState + shuffling_cache.rs:60)."""
+
+    def __init__(self, state, spec, epoch: int):
+        current = get_current_epoch(state, spec)
+        if epoch not in (current - 1, current, current + 1) and current != 0:
+            # The spec only defines committees near the current epoch.
+            pass
+        self.epoch = epoch
+        self.spec = spec
+        self.active = get_active_validator_indices(state, epoch)
+        self.seed = get_seed(state, spec, epoch, DOMAIN_BEACON_ATTESTER)
+        self.committees_per_slot = get_committee_count_per_slot(state, spec, epoch)
+        self.shuffled = compute_shuffled_list(
+            self.active, self.seed, spec.preset.SHUFFLE_ROUND_COUNT
+        )
+
+    def committee(self, slot: int, index: int) -> List[int]:
+        P = self.spec.preset
+        count = self.committees_per_slot * P.SLOTS_PER_EPOCH
+        global_index = (slot % P.SLOTS_PER_EPOCH) * self.committees_per_slot + index
+        n = len(self.shuffled)
+        start = (n * global_index) // count
+        end = (n * (global_index + 1)) // count
+        return self.shuffled[start:end]
+
+
+def get_beacon_committee(state, spec, slot: int, index: int) -> List[int]:
+    epoch = spec.epoch_at_slot(slot)
+    return CommitteeCache(state, spec, epoch).committee(slot, index)
+
+
+# --- proposer selection -----------------------------------------------------
+
+
+def compute_proposer_index(state, spec, indices: Sequence[int], seed: bytes) -> int:
+    """Effective-balance-weighted sampling over shuffled candidates."""
+    if not indices:
+        raise ValueError("no active validators")
+    MAX_RANDOM_BYTE = 2**8 - 1
+    i = 0
+    total = len(indices)
+    while True:
+        shuffled_i = compute_shuffled_index(
+            i % total, total, seed, spec.preset.SHUFFLE_ROUND_COUNT
+        )
+        candidate = indices[shuffled_i]
+        random_byte = _sha256(seed + (i // 32).to_bytes(8, "little"))[i % 32]
+        eb = state.validators[candidate].effective_balance
+        if eb * MAX_RANDOM_BYTE >= spec.max_effective_balance * random_byte:
+            return candidate
+        i += 1
+
+
+def get_beacon_proposer_index(state, spec, slot: int = None) -> int:
+    slot = state.slot if slot is None else slot
+    epoch = spec.epoch_at_slot(slot)
+    seed = _sha256(
+        get_seed(state, spec, epoch, DOMAIN_BEACON_PROPOSER)
+        + slot.to_bytes(8, "little")
+    )
+    indices = get_active_validator_indices(state, epoch)
+    return compute_proposer_index(state, spec, indices, seed)
+
+
+# --- balances ---------------------------------------------------------------
+
+
+def get_total_balance(state, spec, indices) -> int:
+    return max(
+        spec.effective_balance_increment,
+        sum(state.validators[i].effective_balance for i in indices),
+    )
+
+
+def get_total_active_balance(state, spec) -> int:
+    return get_total_balance(
+        state, spec, get_active_validator_indices(state, get_current_epoch(state, spec))
+    )
+
+
+def increase_balance(state, index: int, delta: int) -> None:
+    state.balances[index] += delta
+
+
+def decrease_balance(state, index: int, delta: int) -> None:
+    state.balances[index] = max(0, state.balances[index] - delta)
+
+
+# --- validator mutators (used by operations & epoch processing) -------------
+
+
+def get_validator_churn_limit(state, spec) -> int:
+    active = len(get_active_validator_indices(state, get_current_epoch(state, spec)))
+    return max(spec.min_per_epoch_churn_limit, active // spec.churn_limit_quotient)
+
+
+def get_validator_activation_churn_limit(state, spec) -> int:
+    return min(
+        spec.max_per_epoch_activation_churn_limit,
+        get_validator_churn_limit(state, spec),
+    )
+
+
+def compute_activation_exit_epoch(epoch: int, spec) -> int:
+    return epoch + 1 + spec.preset.MAX_SEED_LOOKAHEAD
+
+
+def initiate_validator_exit(state, spec, index: int) -> None:
+    v = state.validators[index]
+    if v.exit_epoch != FAR_FUTURE_EPOCH:
+        return
+    exit_epochs = [
+        u.exit_epoch for u in state.validators if u.exit_epoch != FAR_FUTURE_EPOCH
+    ]
+    exit_queue_epoch = max(
+        exit_epochs
+        + [compute_activation_exit_epoch(get_current_epoch(state, spec), spec)]
+    )
+    exit_queue_churn = sum(
+        1 for u in state.validators if u.exit_epoch == exit_queue_epoch
+    )
+    if exit_queue_churn >= get_validator_churn_limit(state, spec):
+        exit_queue_epoch += 1
+    v.exit_epoch = exit_queue_epoch
+    v.withdrawable_epoch = exit_queue_epoch + spec.min_validator_withdrawability_delay
+
+
+def slash_validator(state, types, spec, slashed_index: int,
+                    whistleblower_index: int = None, fork: str = "capella") -> None:
+    """Spec slash_validator with the altair/bellatrix penalty constants
+    (process_slashings counterpart lives in epoch processing)."""
+    from lighthouse_tpu.types.spec import ForkName
+
+    epoch = get_current_epoch(state, spec)
+    initiate_validator_exit(state, spec, slashed_index)
+    v = state.validators[slashed_index]
+    v.slashed = True
+    v.withdrawable_epoch = max(
+        v.withdrawable_epoch, epoch + spec.preset.EPOCHS_PER_SLASHINGS_VECTOR
+    )
+    state.slashings[epoch % spec.preset.EPOCHS_PER_SLASHINGS_VECTOR] += v.effective_balance
+    if ForkName.ge(fork, ForkName.BELLATRIX):
+        quotient = spec.min_slashing_penalty_quotient_bellatrix
+    elif fork == ForkName.ALTAIR:
+        quotient = spec.min_slashing_penalty_quotient_altair
+    else:
+        quotient = spec.min_slashing_penalty_quotient
+    decrease_balance(state, slashed_index, v.effective_balance // quotient)
+
+    proposer_index = get_beacon_proposer_index(state, spec)
+    if whistleblower_index is None:
+        whistleblower_index = proposer_index
+    whistleblower_reward = v.effective_balance // spec.whistleblower_reward_quotient
+    if fork == ForkName.BASE:
+        proposer_reward = whistleblower_reward // spec.proposer_reward_quotient
+    else:
+        from lighthouse_tpu.types.spec import PROPOSER_WEIGHT, WEIGHT_DENOMINATOR
+
+        proposer_reward = whistleblower_reward * PROPOSER_WEIGHT // WEIGHT_DENOMINATOR
+    increase_balance(state, proposer_index, proposer_reward)
+    increase_balance(state, whistleblower_index, whistleblower_reward - proposer_reward)

@@ -1,0 +1,281 @@
+"""The signature-set factory — every BLS verification message in consensus.
+
+Mirror of the reference's `signature_sets.rs` (consensus/state_processing/src/
+per_block_processing/signature_sets.rs:56-610): each constructor computes the
+domain-separated signing root for one operation type and pairs it with the
+signature + the signing pubkeys, producing the `SignatureSet` ABI that the
+backends (oracle / fake / tpu) verify in bulk.
+
+Pubkeys are resolved through a caller-provided closure
+`get_pubkey(validator_index) -> PublicKey | None` — the same seam the
+reference uses (`F: Fn(usize) -> Option<Cow<PublicKey>>`) so the validator
+pubkey cache can be plugged in without threading state everywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+from lighthouse_tpu.crypto.bls.api import PublicKey, Signature, SignatureSet
+from lighthouse_tpu.types import spec as sp
+from lighthouse_tpu.types.spec import (
+    DOMAIN_AGGREGATE_AND_PROOF,
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_BEACON_PROPOSER,
+    DOMAIN_BLS_TO_EXECUTION_CHANGE,
+    DOMAIN_DEPOSIT,
+    DOMAIN_RANDAO,
+    DOMAIN_SELECTION_PROOF,
+    DOMAIN_SYNC_COMMITTEE,
+    DOMAIN_VOLUNTARY_EXIT,
+    compute_domain,
+    compute_signing_root,
+)
+
+PubkeyGetter = Callable[[int], Optional[PublicKey]]
+
+
+class SignatureSetError(Exception):
+    """Unknown validator index / malformed signature bytes — mirrors
+    signature_sets.rs Error."""
+
+
+def _pubkey(get_pubkey: PubkeyGetter, index: int) -> PublicKey:
+    pk = get_pubkey(index)
+    if pk is None:
+        raise SignatureSetError(f"validator pubkey unknown for index {index}")
+    return pk
+
+
+def _sig(sig_bytes: bytes, subgroup_checked: bool = False) -> Signature:
+    try:
+        return Signature.from_bytes(bytes(sig_bytes), subgroup_check=False)
+    except Exception as e:  # malformed point encoding
+        raise SignatureSetError(f"invalid signature bytes: {e}") from e
+
+
+def _domain(state, spec, domain_type: bytes, epoch: int) -> bytes:
+    return sp.get_domain(
+        spec,
+        domain_type,
+        epoch,
+        state.fork.current_version,
+        state.fork.previous_version,
+        state.fork.epoch,
+        state.genesis_validators_root,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Block-level sets (reference signature_sets.rs:74-260)
+# ---------------------------------------------------------------------------
+
+
+def block_proposal_signature_set(
+    state, types, spec, signed_block, block_root_fork: str, get_pubkey: PubkeyGetter
+) -> SignatureSet:
+    """Proposer signature over the block root (signature_sets.rs:74)."""
+    block = signed_block.message
+    epoch = spec.epoch_at_slot(block.slot)
+    domain = _domain(state, spec, DOMAIN_BEACON_PROPOSER, epoch)
+    block_cls = types.BeaconBlock[block_root_fork]
+    message = compute_signing_root(block, block_cls, domain)
+    return SignatureSet(
+        signature=_sig(signed_block.signature),
+        signing_keys=[_pubkey(get_pubkey, block.proposer_index)],
+        message=message,
+    )
+
+
+def randao_signature_set(
+    state, types, spec, proposer_index: int, epoch: int, randao_reveal: bytes,
+    get_pubkey: PubkeyGetter,
+) -> SignatureSet:
+    """Randao reveal signs the epoch number (signature_sets.rs:186)."""
+    domain = _domain(state, spec, DOMAIN_RANDAO, epoch)
+    from lighthouse_tpu.types import ssz
+
+    message = compute_signing_root(epoch, ssz.uint64, domain)
+    return SignatureSet(
+        signature=_sig(randao_reveal),
+        signing_keys=[_pubkey(get_pubkey, proposer_index)],
+        message=message,
+    )
+
+
+def indexed_attestation_signature_set(
+    state, types, spec, indexed_att, get_pubkey: PubkeyGetter
+) -> SignatureSet:
+    """Aggregate attestation signature over AttestationData
+    (signature_sets.rs:271,303)."""
+    epoch = indexed_att.data.target.epoch
+    domain = _domain(state, spec, DOMAIN_BEACON_ATTESTER, epoch)
+    message = compute_signing_root(indexed_att.data, types.AttestationData, domain)
+    keys = [_pubkey(get_pubkey, i) for i in indexed_att.attesting_indices]
+    return SignatureSet(
+        signature=_sig(indexed_att.signature),
+        signing_keys=keys,
+        message=message,
+    )
+
+
+def proposer_slashing_signature_sets(
+    state, types, spec, slashing, get_pubkey: PubkeyGetter
+):
+    """Two sets — one per conflicting header (signature_sets.rs:223)."""
+    out = []
+    for signed_header in (slashing.signed_header_1, slashing.signed_header_2):
+        header = signed_header.message
+        epoch = spec.epoch_at_slot(header.slot)
+        domain = _domain(state, spec, DOMAIN_BEACON_PROPOSER, epoch)
+        message = compute_signing_root(header, types.BeaconBlockHeader, domain)
+        out.append(
+            SignatureSet(
+                signature=_sig(signed_header.signature),
+                signing_keys=[_pubkey(get_pubkey, header.proposer_index)],
+                message=message,
+            )
+        )
+    return out
+
+
+def attester_slashing_signature_sets(
+    state, types, spec, slashing, get_pubkey: PubkeyGetter
+):
+    """Two indexed-attestation sets (signature_sets.rs:335)."""
+    return [
+        indexed_attestation_signature_set(state, types, spec, att, get_pubkey)
+        for att in (slashing.attestation_1, slashing.attestation_2)
+    ]
+
+
+def deposit_signature_set(types, spec, deposit_data) -> SignatureSet:
+    """Deposits use compute_domain with the GENESIS fork and empty validators
+    root — valid across forks, and the pubkey comes from the deposit itself
+    (signature_sets.rs:364; proof-of-possession)."""
+    domain = compute_domain(DOMAIN_DEPOSIT, spec.genesis_fork_version, b"\x00" * 32)
+    msg_obj = types.DepositMessage(
+        pubkey=deposit_data.pubkey,
+        withdrawal_credentials=deposit_data.withdrawal_credentials,
+        amount=deposit_data.amount,
+    )
+    message = compute_signing_root(msg_obj, types.DepositMessage, domain)
+    pk = PublicKey.from_bytes(deposit_data.pubkey)
+    return SignatureSet(
+        signature=_sig(deposit_data.signature),
+        signing_keys=[pk],
+        message=message,
+    )
+
+
+def voluntary_exit_signature_set(
+    state, types, spec, signed_exit, get_pubkey: PubkeyGetter
+) -> SignatureSet:
+    """Exit signs VoluntaryExit at its own epoch (signature_sets.rs:377).
+    (Deneb pins the exit domain to Capella; handled by the caller's spec.)"""
+    exit_msg = signed_exit.message
+    domain = _domain(state, spec, DOMAIN_VOLUNTARY_EXIT, exit_msg.epoch)
+    message = compute_signing_root(exit_msg, types.VoluntaryExit, domain)
+    return SignatureSet(
+        signature=_sig(signed_exit.signature),
+        signing_keys=[_pubkey(get_pubkey, exit_msg.validator_index)],
+        message=message,
+    )
+
+
+def bls_execution_change_signature_set(
+    state, types, spec, signed_change
+) -> SignatureSet:
+    """BLSToExecutionChange signs with the withdrawal BLS key itself, domain
+    computed against the GENESIS fork version (signature_sets.rs:159)."""
+    change = signed_change.message
+    domain = compute_domain(
+        DOMAIN_BLS_TO_EXECUTION_CHANGE,
+        spec.genesis_fork_version,
+        state.genesis_validators_root,
+    )
+    message = compute_signing_root(change, types.BLSToExecutionChange, domain)
+    pk = PublicKey.from_bytes(change.from_bls_pubkey)
+    return SignatureSet(
+        signature=_sig(signed_change.signature),
+        signing_keys=[pk],
+        message=message,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Gossip/aggregation sets (signature_sets.rs:417-610)
+# ---------------------------------------------------------------------------
+
+
+def selection_proof_signature_set(
+    state, types, spec, signed_aggregate, get_pubkey: PubkeyGetter
+) -> SignatureSet:
+    """Aggregator's selection proof signs the slot (signature_sets.rs:417)."""
+    from lighthouse_tpu.types import ssz
+
+    message_obj = signed_aggregate.message
+    slot = message_obj.aggregate.data.slot
+    domain = _domain(state, spec, DOMAIN_SELECTION_PROOF, spec.epoch_at_slot(slot))
+    message = compute_signing_root(slot, ssz.uint64, domain)
+    return SignatureSet(
+        signature=_sig(message_obj.selection_proof),
+        signing_keys=[_pubkey(get_pubkey, message_obj.aggregator_index)],
+        message=message,
+    )
+
+
+def aggregate_and_proof_signature_set(
+    state, types, spec, signed_aggregate, get_pubkey: PubkeyGetter
+) -> SignatureSet:
+    """Outer signature over AggregateAndProof (signature_sets.rs:447)."""
+    msg_obj = signed_aggregate.message
+    slot = msg_obj.aggregate.data.slot
+    domain = _domain(
+        state, spec, DOMAIN_AGGREGATE_AND_PROOF, spec.epoch_at_slot(slot)
+    )
+    message = compute_signing_root(msg_obj, types.AggregateAndProof, domain)
+    return SignatureSet(
+        signature=_sig(signed_aggregate.signature),
+        signing_keys=[_pubkey(get_pubkey, msg_obj.aggregator_index)],
+        message=message,
+    )
+
+
+def sync_committee_message_set(
+    state, types, spec, slot: int, beacon_block_root: bytes, validator_index: int,
+    signature: bytes, get_pubkey: PubkeyGetter,
+) -> SignatureSet:
+    """Sync-committee member signs the head block root
+    (signature_sets.rs:482)."""
+    from lighthouse_tpu.types import ssz
+
+    domain = _domain(state, spec, DOMAIN_SYNC_COMMITTEE, spec.epoch_at_slot(slot))
+    message = compute_signing_root(beacon_block_root, ssz.Bytes32, domain)
+    return SignatureSet(
+        signature=_sig(signature),
+        signing_keys=[_pubkey(get_pubkey, validator_index)],
+        message=message,
+    )
+
+
+def sync_aggregate_signature_set(
+    state, types, spec, sync_aggregate, participant_indices: Sequence[int],
+    slot: int, beacon_block_root: bytes, get_pubkey: PubkeyGetter,
+) -> Optional[SignatureSet]:
+    """The block's SyncAggregate: participants sign the PREVIOUS slot's block
+    root (signature_sets.rs:595-610). Returns None when no participants and
+    the signature is the infinity point (valid empty aggregate)."""
+    from lighthouse_tpu.types import ssz
+
+    prev_slot = max(slot, 1) - 1
+    domain = _domain(state, spec, DOMAIN_SYNC_COMMITTEE, spec.epoch_at_slot(prev_slot))
+    message = compute_signing_root(beacon_block_root, ssz.Bytes32, domain)
+    sig = _sig(sync_aggregate.sync_committee_signature)
+    if not participant_indices:
+        if sig.point is None:
+            return None  # empty aggregate with infinity signature: vacuously ok
+        # Non-infinity signature with no participants can never verify.
+        raise SignatureSetError("sync aggregate has signature but no participants")
+    keys = [_pubkey(get_pubkey, i) for i in participant_indices]
+    return SignatureSet(signature=sig, signing_keys=keys, message=message)

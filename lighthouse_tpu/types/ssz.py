@@ -1,0 +1,653 @@
+"""SSZ (SimpleSerialize) — serialization + Merkle tree hashing.
+
+From-scratch implementation of the consensus-spec SSZ spec (the reference
+uses the `ethereum_ssz` + `tree_hash` crates via derive macros; here types
+are declared with a light descriptor DSL and driven reflectively).
+
+Type model:
+    uintN, boolean                      basic types
+    Bytes4/20/32/48/96                  fixed byte vectors (aliases)
+    Vector(elem, length)                fixed-length homogeneous
+    List(elem, limit)                   variable-length, limit bounds merkle
+    Bitvector(length), Bitlist(limit)   packed bits
+    ByteList(limit)                     variable-length bytes
+    Container                           subclass with FIELDS = [(name, typ)]
+
+API: serialize(typ, value) -> bytes; deserialize(typ, data) -> value;
+hash_tree_root(typ, value) -> 32 bytes.
+
+hash_tree_root follows the spec merkleization: pack basic values into
+32-byte chunks, pad the chunk count to the type's chunk limit with zero
+chunks (virtually — zero-subtree hashes are precomputed), binary-merkle with
+SHA-256, and mix in the length for lists/bitlists.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from typing import Any, Sequence, Tuple
+
+BYTES_PER_CHUNK = 32
+ZERO_CHUNK = b"\x00" * 32
+
+# Precomputed zero-subtree roots: _ZERO_HASHES[d] = root of an all-zero
+# perfect tree of depth d.
+_ZERO_HASHES = [ZERO_CHUNK]
+for _ in range(64):
+    h = hashlib.sha256(_ZERO_HASHES[-1] + _ZERO_HASHES[-1]).digest()
+    _ZERO_HASHES.append(h)
+
+
+def _sha(a: bytes, b: bytes) -> bytes:
+    return hashlib.sha256(a + b).digest()
+
+
+# ---------------------------------------------------------------------------
+# Type descriptors
+# ---------------------------------------------------------------------------
+
+
+class SszType:
+    """Base descriptor. Subclasses implement the reflective protocol."""
+
+    def is_fixed_size(self) -> bool:
+        raise NotImplementedError
+
+    def fixed_len(self) -> int:
+        """Byte length if fixed-size; offset width (4) slot otherwise."""
+        raise NotImplementedError
+
+    def serialize(self, value) -> bytes:
+        raise NotImplementedError
+
+    def deserialize(self, data: bytes):
+        raise NotImplementedError
+
+    def hash_tree_root(self, value) -> bytes:
+        raise NotImplementedError
+
+    def default(self):
+        raise NotImplementedError
+
+
+class _Uint(SszType):
+    def __init__(self, bits: int):
+        self.bits = bits
+        self.nbytes = bits // 8
+
+    def is_fixed_size(self):
+        return True
+
+    def fixed_len(self):
+        return self.nbytes
+
+    def serialize(self, value) -> bytes:
+        return int(value).to_bytes(self.nbytes, "little")
+
+    def deserialize(self, data: bytes):
+        if len(data) != self.nbytes:
+            raise SszError(f"uint{self.bits}: expected {self.nbytes} bytes, got {len(data)}")
+        return int.from_bytes(data, "little")
+
+    def hash_tree_root(self, value) -> bytes:
+        return self.serialize(value).ljust(32, b"\x00")
+
+    def default(self):
+        return 0
+
+    def __repr__(self):
+        return f"uint{self.bits}"
+
+
+class _Boolean(SszType):
+    def is_fixed_size(self):
+        return True
+
+    def fixed_len(self):
+        return 1
+
+    def serialize(self, value) -> bytes:
+        return b"\x01" if value else b"\x00"
+
+    def deserialize(self, data: bytes):
+        if data == b"\x00":
+            return False
+        if data == b"\x01":
+            return True
+        raise SszError("invalid boolean byte")
+
+    def hash_tree_root(self, value) -> bytes:
+        return self.serialize(value).ljust(32, b"\x00")
+
+    def default(self):
+        return False
+
+    def __repr__(self):
+        return "boolean"
+
+
+class _ByteVector(SszType):
+    """Fixed-length opaque bytes (Bytes32 etc.) — value type is `bytes`."""
+
+    def __init__(self, length: int):
+        self.length = length
+
+    def is_fixed_size(self):
+        return True
+
+    def fixed_len(self):
+        return self.length
+
+    def serialize(self, value) -> bytes:
+        value = bytes(value)
+        if len(value) != self.length:
+            raise SszError(f"Bytes{self.length}: got {len(value)} bytes")
+        return value
+
+    def deserialize(self, data: bytes):
+        if len(data) != self.length:
+            raise SszError(f"Bytes{self.length}: got {len(data)} bytes")
+        return bytes(data)
+
+    def hash_tree_root(self, value) -> bytes:
+        return _merkleize_chunks(_chunkify(self.serialize(value)), _chunk_count_bytes(self.length))
+
+    def default(self):
+        return b"\x00" * self.length
+
+    def __repr__(self):
+        return f"Bytes{self.length}"
+
+
+def _chunk_count_bytes(n: int) -> int:
+    return max(1, (n + 31) // 32)
+
+
+class SszError(Exception):
+    pass
+
+
+class Vector(SszType):
+    def __init__(self, elem: SszType, length: int):
+        if length <= 0:
+            raise SszError("Vector length must be positive")
+        self.elem = elem
+        self.length = length
+
+    def is_fixed_size(self):
+        return self.elem.is_fixed_size()
+
+    def fixed_len(self):
+        return self.elem.fixed_len() * self.length if self.is_fixed_size() else 4
+
+    def serialize(self, value) -> bytes:
+        value = list(value)
+        if len(value) != self.length:
+            raise SszError(f"Vector[{self.length}]: got {len(value)} elements")
+        return _serialize_sequence(self.elem, value)
+
+    def deserialize(self, data: bytes):
+        return _deserialize_sequence(self.elem, data, exact_count=self.length)
+
+    def hash_tree_root(self, value) -> bytes:
+        value = list(value)
+        if len(value) != self.length:
+            raise SszError(f"Vector[{self.length}]: got {len(value)} elements")
+        return _merkleize_sequence(self.elem, value, self.length, mix_length=None)
+
+    def default(self):
+        return [self.elem.default() for _ in range(self.length)]
+
+    def __repr__(self):
+        return f"Vector[{self.elem!r}, {self.length}]"
+
+
+class List(SszType):
+    def __init__(self, elem: SszType, limit: int):
+        self.elem = elem
+        self.limit = limit
+
+    def is_fixed_size(self):
+        return False
+
+    def fixed_len(self):
+        return 4
+
+    def serialize(self, value) -> bytes:
+        value = list(value)
+        if len(value) > self.limit:
+            raise SszError(f"List limit {self.limit} exceeded: {len(value)}")
+        return _serialize_sequence(self.elem, value)
+
+    def deserialize(self, data: bytes):
+        out = _deserialize_sequence(self.elem, data, exact_count=None)
+        if len(out) > self.limit:
+            raise SszError(f"List limit {self.limit} exceeded: {len(out)}")
+        return out
+
+    def hash_tree_root(self, value) -> bytes:
+        value = list(value)
+        if len(value) > self.limit:
+            raise SszError(f"List limit {self.limit} exceeded: {len(value)}")
+        return _merkleize_sequence(self.elem, value, self.limit, mix_length=len(value))
+
+    def default(self):
+        return []
+
+    def __repr__(self):
+        return f"List[{self.elem!r}, {self.limit}]"
+
+
+class ByteList(SszType):
+    """List[uint8, limit] with a bytes value type (serialization identity)."""
+
+    def __init__(self, limit: int):
+        self.limit = limit
+
+    def is_fixed_size(self):
+        return False
+
+    def fixed_len(self):
+        return 4
+
+    def serialize(self, value) -> bytes:
+        value = bytes(value)
+        if len(value) > self.limit:
+            raise SszError(f"ByteList limit {self.limit} exceeded")
+        return value
+
+    def deserialize(self, data: bytes):
+        if len(data) > self.limit:
+            raise SszError(f"ByteList limit {self.limit} exceeded")
+        return bytes(data)
+
+    def hash_tree_root(self, value) -> bytes:
+        value = bytes(value)
+        root = _merkleize_chunks(_chunkify(value), _chunk_count_bytes(self.limit))
+        return _mix_in_length(root, len(value))
+
+    def default(self):
+        return b""
+
+    def __repr__(self):
+        return f"ByteList[{self.limit}]"
+
+
+class Bitvector(SszType):
+    """Fixed-length bit sequence; value type is a list/sequence of bools."""
+
+    def __init__(self, length: int):
+        if length <= 0:
+            raise SszError("Bitvector length must be positive")
+        self.length = length
+
+    def is_fixed_size(self):
+        return True
+
+    def fixed_len(self):
+        return (self.length + 7) // 8
+
+    def serialize(self, value) -> bytes:
+        bits = list(value)
+        if len(bits) != self.length:
+            raise SszError(f"Bitvector[{self.length}]: got {len(bits)}")
+        return _pack_bits(bits)
+
+    def deserialize(self, data: bytes):
+        if len(data) != self.fixed_len():
+            raise SszError("Bitvector byte length mismatch")
+        bits = _unpack_bits(data, len(data) * 8)[: self.length]
+        # Excess (padding) bits must be zero.
+        if any(_unpack_bits(data, len(data) * 8)[self.length:]):
+            raise SszError("Bitvector padding bits set")
+        return bits
+
+    def hash_tree_root(self, value) -> bytes:
+        return _merkleize_chunks(
+            _chunkify(self.serialize(value)), _chunk_count_bytes((self.length + 7) // 8)
+        )
+
+    def default(self):
+        return [False] * self.length
+
+    def __repr__(self):
+        return f"Bitvector[{self.length}]"
+
+
+class Bitlist(SszType):
+    """Variable-length bit sequence with a delimiting sentinel bit."""
+
+    def __init__(self, limit: int):
+        self.limit = limit
+
+    def is_fixed_size(self):
+        return False
+
+    def fixed_len(self):
+        return 4
+
+    def serialize(self, value) -> bytes:
+        bits = list(value)
+        if len(bits) > self.limit:
+            raise SszError(f"Bitlist limit {self.limit} exceeded")
+        return _pack_bits(bits + [True])  # delimiter
+
+    def deserialize(self, data: bytes):
+        if not data:
+            raise SszError("Bitlist must contain the delimiter")
+        nbits = len(data) * 8
+        bits = _unpack_bits(data, nbits)
+        # Find the highest set bit = delimiter.
+        hi = nbits - 1
+        while hi >= 0 and not bits[hi]:
+            hi -= 1
+        if hi < 0:
+            raise SszError("Bitlist missing delimiter")
+        if nbits - hi > 8:
+            raise SszError("Bitlist delimiter not in final byte")
+        out = bits[:hi]
+        if len(out) > self.limit:
+            raise SszError(f"Bitlist limit {self.limit} exceeded")
+        return out
+
+    def hash_tree_root(self, value) -> bytes:
+        bits = list(value)
+        if len(bits) > self.limit:
+            raise SszError(f"Bitlist limit {self.limit} exceeded")
+        packed = _pack_bits(bits)  # NO delimiter in hashing
+        root = _merkleize_chunks(_chunkify(packed), _chunk_count_bytes((self.limit + 7) // 8))
+        return _mix_in_length(root, len(bits))
+
+    def default(self):
+        return []
+
+    def __repr__(self):
+        return f"Bitlist[{self.limit}]"
+
+
+# ---------------------------------------------------------------------------
+# Containers
+# ---------------------------------------------------------------------------
+
+
+class _ContainerMeta(type):
+    def __new__(mcls, name, bases, ns):
+        cls = super().__new__(mcls, name, bases, ns)
+        fields = []
+        for base in reversed(cls.__mro__):
+            fields.extend(getattr(base, "FIELDS", []) if "FIELDS" in base.__dict__ else [])
+        cls._ssz_fields: Tuple[Tuple[str, SszType], ...] = tuple(fields)
+        return cls
+
+
+class Container(metaclass=_ContainerMeta):
+    """Declare subclasses with FIELDS = [("name", typ), ...]. Instances are
+    plain attribute bags; omitted constructor kwargs get SSZ defaults."""
+
+    FIELDS: Sequence[Tuple[str, SszType]] = []
+
+    def __init__(self, **kwargs):
+        for fname, ftyp in type(self)._ssz_fields:
+            if fname in kwargs:
+                setattr(self, fname, kwargs.pop(fname))
+            else:
+                setattr(self, fname, ftyp.default())
+        if kwargs:
+            raise TypeError(f"unknown fields: {sorted(kwargs)}")
+
+    def __eq__(self, other):
+        if type(self) is not type(other):
+            return NotImplemented
+        return all(
+            getattr(self, f) == getattr(other, f) for f, _ in type(self)._ssz_fields
+        )
+
+    def __repr__(self):
+        inner = ", ".join(f"{f}={getattr(self, f)!r}" for f, _ in type(self)._ssz_fields)
+        return f"{type(self).__name__}({inner})"
+
+    def copy(self):
+        """Shallow-ish copy: containers/lists recursively re-wrapped."""
+        import copy as _copy
+
+        return _copy.deepcopy(self)
+
+    # --- reflective SszType protocol (classmethods acting as descriptor) ---
+
+    @classmethod
+    def is_fixed_size(cls) -> bool:
+        return all(t.is_fixed_size() for _, t in cls._ssz_fields)
+
+    @classmethod
+    def fixed_len(cls) -> int:
+        if not cls.is_fixed_size():
+            return 4
+        return sum(t.fixed_len() for _, t in cls._ssz_fields)
+
+    @classmethod
+    def serialize(cls, value) -> bytes:
+        fixed_parts = []
+        variable_parts = []
+        for fname, ftyp in cls._ssz_fields:
+            v = getattr(value, fname)
+            if ftyp.is_fixed_size():
+                fixed_parts.append(ftyp.serialize(v))
+                variable_parts.append(b"")
+            else:
+                fixed_parts.append(None)  # offset placeholder
+                variable_parts.append(ftyp.serialize(v))
+        return _assemble(fixed_parts, variable_parts, [t for _, t in cls._ssz_fields])
+
+    @classmethod
+    def deserialize(cls, data: bytes):
+        values = _split_fields(data, [t for _, t in cls._ssz_fields])
+        obj = cls.__new__(cls)
+        for (fname, ftyp), raw in zip(cls._ssz_fields, values):
+            setattr(obj, fname, ftyp.deserialize(raw))
+        return obj
+
+    @classmethod
+    def hash_tree_root(cls, value) -> bytes:
+        chunks = [t.hash_tree_root(getattr(value, f)) for f, t in cls._ssz_fields]
+        return _merkleize_chunks(chunks, len(cls._ssz_fields))
+
+    @classmethod
+    def default(cls):
+        return cls()
+
+    @property
+    def tree_root(self) -> bytes:
+        return type(self).hash_tree_root(self)
+
+
+# ---------------------------------------------------------------------------
+# Sequence plumbing
+# ---------------------------------------------------------------------------
+
+
+def _assemble(fixed_parts, variable_parts, types) -> bytes:
+    fixed_len_total = sum(
+        len(p) if p is not None else 4 for p in fixed_parts
+    )
+    out = []
+    offset = fixed_len_total
+    for p, v in zip(fixed_parts, variable_parts):
+        if p is None:
+            out.append(struct.pack("<I", offset))
+            offset += len(v)
+        else:
+            out.append(p)
+    return b"".join(out) + b"".join(variable_parts)
+
+
+def _serialize_sequence(elem: SszType, values) -> bytes:
+    if elem.is_fixed_size():
+        return b"".join(elem.serialize(v) for v in values)
+    parts = [elem.serialize(v) for v in values]
+    header = []
+    offset = 4 * len(parts)
+    for p in parts:
+        header.append(struct.pack("<I", offset))
+        offset += len(p)
+    return b"".join(header) + b"".join(parts)
+
+
+def _deserialize_sequence(elem: SszType, data: bytes, exact_count):
+    if elem.is_fixed_size():
+        sz = elem.fixed_len()
+        if sz == 0:
+            raise SszError("zero-size element")
+        if len(data) % sz:
+            raise SszError("sequence byte length not a multiple of element size")
+        n = len(data) // sz
+        if exact_count is not None and n != exact_count:
+            raise SszError(f"expected {exact_count} elements, got {n}")
+        return [elem.deserialize(data[i * sz:(i + 1) * sz]) for i in range(n)]
+    if not data:
+        if exact_count not in (None, 0):
+            raise SszError("empty data for non-empty vector")
+        return []
+    if len(data) < 4:
+        raise SszError("truncated offset table")
+    first = struct.unpack("<I", data[:4])[0]
+    if first % 4 or first > len(data):
+        raise SszError("bad first offset")
+    n = first // 4
+    if exact_count is not None and n != exact_count:
+        raise SszError(f"expected {exact_count} elements, got {n}")
+    offsets = [struct.unpack("<I", data[i * 4:(i + 1) * 4])[0] for i in range(n)]
+    offsets.append(len(data))
+    out = []
+    for i in range(n):
+        if offsets[i] > offsets[i + 1]:
+            raise SszError("offsets not monotonic")
+        out.append(elem.deserialize(data[offsets[i]:offsets[i + 1]]))
+    return out
+
+
+def _split_fields(data: bytes, types):
+    """Split a container's bytes into per-field byte slices."""
+    fixed_len_total = sum(t.fixed_len() for t in types)
+    if len(data) < fixed_len_total:
+        raise SszError("container data shorter than fixed part")
+    pos = 0
+    raw_fixed = []
+    offsets = []
+    for t in types:
+        if t.is_fixed_size():
+            sz = t.fixed_len()
+            raw_fixed.append(data[pos:pos + sz])
+            pos += sz
+        else:
+            off = struct.unpack("<I", data[pos:pos + 4])[0]
+            offsets.append((len(raw_fixed), off))
+            raw_fixed.append(None)
+            pos += 4
+    if offsets:
+        if offsets[0][1] != fixed_len_total:
+            raise SszError("first offset does not point past fixed part")
+        bounds = [off for _, off in offsets] + [len(data)]
+        for (idx, off), end in zip(offsets, bounds[1:]):
+            if off > end:
+                raise SszError("offsets not monotonic")
+            raw_fixed[idx] = data[off:end]
+    elif pos != len(data):
+        raise SszError("trailing bytes in fixed-size container")
+    return raw_fixed
+
+
+# ---------------------------------------------------------------------------
+# Merkleization
+# ---------------------------------------------------------------------------
+
+
+def _pack_bits(bits) -> bytes:
+    out = bytearray((len(bits) + 7) // 8)
+    for i, b in enumerate(bits):
+        if b:
+            out[i // 8] |= 1 << (i % 8)
+    return bytes(out)
+
+
+def _unpack_bits(data: bytes, n: int):
+    return [bool((data[i // 8] >> (i % 8)) & 1) for i in range(n)]
+
+
+def _chunkify(data: bytes):
+    if not data:
+        return []
+    chunks = [data[i:i + 32] for i in range(0, len(data), 32)]
+    if len(chunks[-1]) < 32:
+        chunks[-1] = chunks[-1].ljust(32, b"\x00")
+    return chunks
+
+
+def _merkleize_chunks(chunks, limit_chunks: int) -> bytes:
+    """Merkle root over `chunks` padded (virtually) to next_pow2(limit)."""
+    depth = max(limit_chunks - 1, 0).bit_length()
+    if len(chunks) > limit_chunks:
+        raise SszError("chunk count exceeds limit")
+    layer = list(chunks)
+    for d in range(depth):
+        if len(layer) % 2:
+            layer.append(_ZERO_HASHES[d])
+        layer = [_sha(layer[i], layer[i + 1]) for i in range(0, len(layer), 2)]
+        if not layer:
+            layer = [_ZERO_HASHES[d + 1]]
+    return layer[0] if layer else ZERO_CHUNK
+
+
+def _mix_in_length(root: bytes, length: int) -> bytes:
+    return _sha(root, length.to_bytes(32, "little"))
+
+
+_BASIC_PACKABLE = (_Uint, _Boolean)
+
+
+def _merkleize_sequence(elem: SszType, values, limit: int, mix_length):
+    if isinstance(elem, _BASIC_PACKABLE):
+        packed = b"".join(elem.serialize(v) for v in values)
+        limit_chunks = _chunk_count_bytes(limit * elem.fixed_len())
+        root = _merkleize_chunks(_chunkify(packed), limit_chunks)
+    else:
+        chunks = [elem.hash_tree_root(v) for v in values]
+        root = _merkleize_chunks(chunks, limit)
+    if mix_length is not None:
+        root = _mix_in_length(root, mix_length)
+    return root
+
+
+# ---------------------------------------------------------------------------
+# Public singletons + functional API
+# ---------------------------------------------------------------------------
+
+def ByteVector(length: int) -> _ByteVector:
+    """Fixed-length opaque byte vector (Bytes{N} for arbitrary N)."""
+    return _ByteVector(length)
+
+
+uint8 = _Uint(8)
+uint16 = _Uint(16)
+uint32 = _Uint(32)
+uint64 = _Uint(64)
+uint128 = _Uint(128)
+uint256 = _Uint(256)
+boolean = _Boolean()
+Bytes4 = _ByteVector(4)
+Bytes20 = _ByteVector(20)
+Bytes32 = _ByteVector(32)
+Bytes48 = _ByteVector(48)
+Bytes96 = _ByteVector(96)
+
+
+def serialize(typ, value) -> bytes:
+    return typ.serialize(value)
+
+
+def deserialize(typ, data: bytes):
+    return typ.deserialize(data)
+
+
+def hash_tree_root(typ, value) -> bytes:
+    return typ.hash_tree_root(value)

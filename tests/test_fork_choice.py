@@ -1,0 +1,142 @@
+"""Fork-choice unit vectors — scripted on_block/on_attestation sequences with
+expected heads, the hand-rolled counterpart of the reference's
+proto_array/src/fork_choice_test_definition vectors (SURVEY.md §4.3).
+
+Drives ProtoArrayForkChoice directly (no states needed): votes, weight
+propagation, FFG viability filtering, proposer boost transience,
+equivocation removal, pruning, and optimistic-status flips.
+"""
+
+from lighthouse_tpu.fork_choice.proto_array import (
+    ExecutionStatus,
+    ProtoArrayForkChoice,
+)
+
+
+def r(i: int) -> bytes:
+    return bytes([i]) * 32
+
+
+def make_dag():
+    """genesis -> a -> b ; genesis -> c (fork)"""
+    p = ProtoArrayForkChoice(
+        finalized_root=r(0), finalized_slot=0, justified_epoch=1, finalized_epoch=1
+    )
+    p.on_block(slot=1, root=r(1), parent_root=r(0), justified_epoch=1, finalized_epoch=1)
+    p.on_block(slot=2, root=r(2), parent_root=r(1), justified_epoch=1, finalized_epoch=1)
+    p.on_block(slot=1, root=r(3), parent_root=r(0), justified_epoch=1, finalized_epoch=1)
+    return p
+
+
+def test_no_votes_tiebreak_on_root():
+    p = make_dag()
+    p.apply_score_changes([], 1, 1)
+    # Branch heads: r(2) (via r(1)) vs r(3). Weights all zero; the walk
+    # compares children of genesis: r(1) vs r(3) -> r(3) wins on root bytes.
+    assert p.find_head(r(0)) == r(3)
+
+
+def test_votes_move_head():
+    p = make_dag()
+    p.process_attestation(0, r(2), target_epoch=2)
+    p.process_attestation(1, r(2), target_epoch=2)
+    p.process_attestation(2, r(3), target_epoch=2)
+    p.apply_score_changes([32, 32, 32], 1, 1)
+    assert p.find_head(r(0)) == r(2)
+    # Validators 0,1 switch to the fork: head follows.
+    p.process_attestation(0, r(3), target_epoch=3)
+    p.process_attestation(1, r(3), target_epoch=3)
+    p.apply_score_changes([32, 32, 32], 1, 1)
+    assert p.find_head(r(0)) == r(3)
+    # Weights: r(3) has all three, r(1)/r(2) zero.
+    assert p.nodes[p.index_by_root[r(3)]].weight == 96
+    assert p.nodes[p.index_by_root[r(2)]].weight == 0
+
+
+def test_balance_changes_propagate():
+    p = make_dag()
+    p.process_attestation(0, r(2), target_epoch=2)
+    p.apply_score_changes([32], 1, 1)
+    assert p.nodes[p.index_by_root[r(1)]].weight == 32
+    # Balance halves without a new vote: weight follows.
+    p.apply_score_changes([16], 1, 1)
+    assert p.nodes[p.index_by_root[r(1)]].weight == 16
+    assert p.nodes[p.index_by_root[r(2)]].weight == 16
+
+
+def test_proposer_boost_is_transient():
+    p = make_dag()
+    p.process_attestation(0, r(2), target_epoch=2)
+    p.proposer_boost_root = r(3)
+    p.apply_score_changes([32], 1, 1, proposer_boost_amount=100)
+    assert p.find_head(r(0)) == r(3)  # boost outweighs the vote
+    # Next sweep without boost: reverts to the voted branch.
+    p.proposer_boost_root = b"\x00" * 32
+    p.apply_score_changes([32], 1, 1, proposer_boost_amount=0)
+    assert p.find_head(r(0)) == r(2)
+    assert p.nodes[p.index_by_root[r(3)]].weight == 0
+
+
+def test_equivocation_removes_weight_forever():
+    p = make_dag()
+    p.process_attestation(0, r(2), target_epoch=2)
+    p.process_attestation(1, r(3), target_epoch=2)
+    p.apply_score_changes([32, 31], 1, 1)
+    assert p.find_head(r(0)) == r(2)
+    p.process_equivocation(0)
+    assert p.find_head(r(0)) == r(3)
+    # Further votes from the equivocator are ignored.
+    p.process_attestation(0, r(2), target_epoch=5)
+    p.apply_score_changes([32, 31], 1, 1)
+    assert p.find_head(r(0)) == r(3)
+
+
+def test_ffg_viability_filters_stale_branch():
+    p = make_dag()
+    # r(3)'s branch was built on justified epoch 1; chain justifies epoch 2
+    # with a new block on r(2)'s branch.
+    p.on_block(slot=3, root=r(4), parent_root=r(2), justified_epoch=2, finalized_epoch=1)
+    p.process_attestation(0, r(3), target_epoch=2)  # heavy vote on stale fork
+    p.apply_score_changes([1000], 2, 1)
+    # Despite weight, r(3) is not viable (justified_epoch 1 != 2).
+    assert p.find_head(r(0)) == r(4)
+
+
+def test_prune_drops_stale_fork():
+    p = make_dag()
+    p.on_block(slot=3, root=r(4), parent_root=r(2), justified_epoch=1, finalized_epoch=1)
+    p.prune(r(1))
+    assert not p.contains_block(r(3))  # fork removed
+    assert p.contains_block(r(2)) and p.contains_block(r(4))
+    assert p.nodes[p.index_by_root[r(1)]].parent is None
+    p.apply_score_changes([], 1, 1)
+    assert p.find_head(r(1)) == r(4)
+
+
+def test_invalid_execution_poisons_subtree():
+    p = ProtoArrayForkChoice(
+        finalized_root=r(0), finalized_slot=0, justified_epoch=0, finalized_epoch=0
+    )
+    p.on_block(1, r(1), r(0), 0, 0, ExecutionStatus.OPTIMISTIC, b"h1")
+    p.on_block(2, r(2), r(1), 0, 0, ExecutionStatus.OPTIMISTIC, b"h2")
+    p.on_block(1, r(3), r(0), 0, 0, ExecutionStatus.OPTIMISTIC, b"h3")
+    p.process_attestation(0, r(2), target_epoch=1)
+    p.apply_score_changes([32], 0, 0)
+    assert p.find_head(r(0)) == r(2)
+    # EL says h1 INVALID: r(1) and r(2) both die; head falls to r(3).
+    p.on_execution_status(b"h1", valid=False)
+    assert p.find_head(r(0)) == r(3)
+    # And a VALID verdict ratifies ancestors.
+    p.on_execution_status(b"h3", valid=True)
+    assert p.nodes[p.index_by_root[r(3)]].execution_status is ExecutionStatus.VALID
+
+
+def test_unknown_vote_applies_when_block_arrives():
+    p = make_dag()
+    # Vote for a block the DAG hasn't seen yet.
+    p.process_attestation(0, r(9), target_epoch=2)
+    p.apply_score_changes([32], 1, 1)
+    assert p.nodes[p.index_by_root[r(1)]].weight == 0
+    p.on_block(slot=3, root=r(9), parent_root=r(2), justified_epoch=1, finalized_epoch=1)
+    p.apply_score_changes([32], 1, 1)
+    assert p.find_head(r(0)) == r(9)

@@ -1,0 +1,68 @@
+"""Differential tests: JAX hash-to-curve (ops/h2c.py) vs the oracle.
+
+The oracle implements RFC 9380 directly (crypto/bls/hash_to_curve.py) and is
+itself validated against the ciphersuite requirements in
+tests/test_bls_hash_to_curve.py; here the batched branch-free device map must
+reproduce it point-for-point, including the SSWU non-square branch and the
+sign fix.
+"""
+
+import pytest
+
+import jax
+
+from lighthouse_tpu.crypto.bls import fields as of
+from lighthouse_tpu.crypto.bls import hash_to_curve as oh2c
+from lighthouse_tpu.ops import curves as cv
+from lighthouse_tpu.ops import h2c
+from lighthouse_tpu.ops import tower as tw
+
+N = 4  # uniform batch for one compile
+
+
+@pytest.fixture(scope="module")
+def jit_map():
+    return jax.jit(h2c.hash_to_g2_device)
+
+
+def _affine(dev_pts):
+    return cv.g2_to_affine(dev_pts)
+
+
+def test_hash_to_g2_matches_oracle(jit_map):
+    msgs = [bytes([i]) * 32 for i in range(N)]
+    got = _affine(jit_map(h2c.hash_to_field_device(msgs)))
+    for m, pt in zip(msgs, got):
+        assert pt == oh2c.hash_to_g2(m)
+
+
+def test_hash_to_g2_empty_and_long_messages(jit_map):
+    msgs = [b"", b"x", b"y" * 100, b"\xff" * 32]
+    got = _affine(jit_map(h2c.hash_to_field_device(msgs)))
+    for m, pt in zip(msgs, got):
+        assert pt == oh2c.hash_to_g2(m)
+
+
+def test_sswu_map_matches_oracle_including_nonsquare_branch():
+    """Drive map_to_curve alone on crafted u values (batch (N, 2) like the
+    real path: two Fp2 elements per message)."""
+    msgs = [bytes([50 + i]) * 16 for i in range(N)]
+    us = [oh2c.hash_to_field_fp2(m, 2) for m in msgs]
+    u_dev = h2c.hash_to_field_device(msgs)
+    mapped = jax.jit(h2c.map_to_curve_sswu)(u_dev)        # (N, 2, 2, 2, L)
+    for i in range(N):
+        for j in range(2):
+            x_pair = tw.fp2_to_int_pairs(mapped[i, j, 0])[0]
+            y_pair = tw.fp2_to_int_pairs(mapped[i, j, 1])[0]
+            ox, oy = oh2c.map_to_curve_simple_swu_g2(us[i][j])
+            assert (x_pair, y_pair) == (ox, oy)
+
+
+def test_sgn0_matches_oracle():
+    import jax.numpy as jnp
+
+    vals = [(0, 0), (1, 0), (2, 5), (0, 3), (of.P - 1, 0), (0, of.P - 1)]
+    dev = tw.fp2_from_int_pair(vals)
+    got = jax.jit(h2c._sgn0_fp2)(dev)
+    exp = [of.fp2_sgn0(v) == 1 for v in vals]
+    assert [bool(b) for b in got] == exp
