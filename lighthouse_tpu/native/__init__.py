@@ -1,0 +1,48 @@
+"""Native (C++) runtime components and their build machinery.
+
+The reference links native code for its storage and crypto hot paths
+(SURVEY.md §2.7: leveldb C++, blst asm, c-kzg C). Here the TPU compute path
+is JAX/Pallas; the host runtime pieces that must not be Python are built
+from C++ sources in `src/` and loaded via ctypes.
+
+`load(name)` compiles `src/<name>.cpp` into `build/lib<name>.so` on first
+use (g++ is baked into the image; output is cached by mtime) and returns
+the ctypes CDLL.
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src")
+_BUILD = os.path.join(_HERE, "build")
+_lock = threading.Lock()
+_cache = {}
+
+
+def _needs_build(src: str, out: str) -> bool:
+    if not os.path.exists(out):
+        return True
+    return os.path.getmtime(src) > os.path.getmtime(out)
+
+
+def load(name: str) -> ctypes.CDLL:
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        src = os.path.join(_SRC, f"{name}.cpp")
+        out = os.path.join(_BUILD, f"lib{name}.so")
+        os.makedirs(_BUILD, exist_ok=True)
+        if _needs_build(src, out):
+            tmp = out + ".tmp"
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp, src],
+                check=True,
+                capture_output=True,
+            )
+            os.replace(tmp, out)
+        lib = ctypes.CDLL(out)
+        _cache[name] = lib
+        return lib

@@ -1,0 +1,334 @@
+// kvstore — embedded ordered key-value engine for the hot/cold store.
+//
+// Native equivalent of the reference's leveldb backend
+// (beacon_node/store/src/leveldb_store.rs; trait surface lib.rs:53-118):
+// ordered iteration from a start key, atomic write batches, sync writes,
+// compaction. Design is an LSM-lite rather than a leveldb clone:
+//
+//   * in-memory ordered map (std::map) holds the live view;
+//   * a write-ahead log (wal.log) makes every mutation durable — each WAL
+//     record is a whole batch framed with a CRC32, so replay applies a batch
+//     either completely or not at all (torn tails are dropped);
+//   * compact() persists the map as a sorted snapshot (snapshot.dat via
+//     atomic rename) and truncates the WAL.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in the image).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// CRC32 (IEEE, table-driven)
+// ---------------------------------------------------------------------------
+
+uint32_t crc_table[256];
+struct CrcInit {
+  CrcInit() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      crc_table[i] = c;
+    }
+  }
+} crc_init_once;
+
+uint32_t crc32(const uint8_t* data, size_t len, uint32_t seed = 0) {
+  uint32_t c = seed ^ 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; i++) c = crc_table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// ---------------------------------------------------------------------------
+// Serialization helpers
+// ---------------------------------------------------------------------------
+
+void put_u32(std::string& out, uint32_t v) {
+  char b[4];
+  memcpy(b, &v, 4);
+  out.append(b, 4);
+}
+
+uint32_t get_u32(const uint8_t* p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+
+// Batch op codes.
+constexpr uint8_t OP_PUT = 1;
+constexpr uint8_t OP_DEL = 2;
+
+constexpr uint32_t WAL_MAGIC = 0x4C484B56;  // "LHKV"
+constexpr uint32_t SNAP_MAGIC = 0x4C48534E; // "LHSN"
+
+struct DB {
+  std::map<std::string, std::string> map;
+  std::string dir;
+  int wal_fd = -1;
+  std::mutex mu;
+  std::string err;
+
+  std::string wal_path() const { return dir + "/wal.log"; }
+  std::string snap_path() const { return dir + "/snapshot.dat"; }
+};
+
+// Payload layout of one batch: repeated [op:u8][klen:u32][key][vlen:u32][val]
+// (vlen/val omitted for OP_DEL). WAL record: [MAGIC][len:u32][payload][crc:u32].
+bool apply_payload(DB* db, const uint8_t* p, size_t len) {
+  size_t off = 0;
+  // Validate the whole payload first so a malformed batch changes nothing.
+  while (off < len) {
+    if (off + 5 > len) return false;
+    uint8_t op = p[off];
+    uint32_t klen = get_u32(p + off + 1);
+    off += 5;
+    if (off + klen > len) return false;
+    off += klen;
+    if (op == OP_PUT) {
+      if (off + 4 > len) return false;
+      uint32_t vlen = get_u32(p + off);
+      off += 4;
+      if (off + vlen > len) return false;
+      off += vlen;
+    } else if (op != OP_DEL) {
+      return false;
+    }
+  }
+  off = 0;
+  while (off < len) {
+    uint8_t op = p[off];
+    uint32_t klen = get_u32(p + off + 1);
+    off += 5;
+    std::string key(reinterpret_cast<const char*>(p + off), klen);
+    off += klen;
+    if (op == OP_PUT) {
+      uint32_t vlen = get_u32(p + off);
+      off += 4;
+      db->map[std::move(key)] =
+          std::string(reinterpret_cast<const char*>(p + off), vlen);
+      off += vlen;
+    } else {
+      db->map.erase(key);
+    }
+  }
+  return true;
+}
+
+bool write_all(int fd, const void* buf, size_t len) {
+  const char* p = static_cast<const char*>(buf);
+  while (len) {
+    ssize_t n = ::write(fd, p, len);
+    if (n <= 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool read_file(const std::string& path, std::vector<uint8_t>& out) {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) return false;
+  fseek(f, 0, SEEK_END);
+  long sz = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  out.resize(sz > 0 ? static_cast<size_t>(sz) : 0);
+  size_t got = out.empty() ? 0 : fread(out.data(), 1, out.size(), f);
+  fclose(f);
+  out.resize(got);
+  return true;
+}
+
+bool load_snapshot(DB* db) {
+  std::vector<uint8_t> data;
+  if (!read_file(db->snap_path(), data)) return true;  // absent is fine
+  if (data.size() < 12) return true;                   // empty/corrupt: skip
+  if (get_u32(data.data()) != SNAP_MAGIC) return false;
+  uint32_t payload_len = get_u32(data.data() + 4);
+  if (8 + payload_len + 4 > data.size()) return false;
+  uint32_t want = get_u32(data.data() + 8 + payload_len);
+  if (crc32(data.data() + 8, payload_len) != want) return false;
+  return apply_payload(db, data.data() + 8, payload_len);
+}
+
+void replay_wal(DB* db) {
+  std::vector<uint8_t> data;
+  if (!read_file(db->wal_path(), data)) return;
+  size_t off = 0;
+  while (off + 12 <= data.size()) {
+    if (get_u32(data.data() + off) != WAL_MAGIC) break;
+    uint32_t len = get_u32(data.data() + off + 4);
+    if (off + 8 + len + 4 > data.size()) break;  // torn tail
+    uint32_t want = get_u32(data.data() + off + 8 + len);
+    if (crc32(data.data() + off + 8, len) != want) break;
+    apply_payload(db, data.data() + off + 8, len);
+    off += 8 + len + 4;
+  }
+}
+
+bool append_wal(DB* db, const std::string& payload, bool do_sync) {
+  std::string rec;
+  put_u32(rec, WAL_MAGIC);
+  put_u32(rec, static_cast<uint32_t>(payload.size()));
+  rec += payload;
+  put_u32(rec, crc32(reinterpret_cast<const uint8_t*>(payload.data()),
+                     payload.size()));
+  if (!write_all(db->wal_fd, rec.data(), rec.size())) return false;
+  if (do_sync && fdatasync(db->wal_fd) != 0) return false;
+  return true;
+}
+
+struct Iter {
+  std::vector<std::pair<std::string, std::string>> items;
+  size_t pos = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kv_open(const char* path) {
+  DB* db = new DB();
+  db->dir = path;
+  ::mkdir(path, 0755);
+  if (!load_snapshot(db)) {
+    delete db;
+    return nullptr;
+  }
+  replay_wal(db);
+  db->wal_fd = ::open(db->wal_path().c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+  if (db->wal_fd < 0) {
+    delete db;
+    return nullptr;
+  }
+  return db;
+}
+
+void kv_close(void* h) {
+  DB* db = static_cast<DB*>(h);
+  if (db->wal_fd >= 0) ::close(db->wal_fd);
+  delete db;
+}
+
+// batch payload is the WAL payload format described above.
+int kv_apply_batch(void* h, const uint8_t* payload, uint32_t len, int do_sync) {
+  DB* db = static_cast<DB*>(h);
+  std::lock_guard<std::mutex> g(db->mu);
+  std::string p(reinterpret_cast<const char*>(payload), len);
+  if (!append_wal(db, p, do_sync != 0)) return -1;
+  if (!apply_payload(db, payload, len)) return -2;
+  return 0;
+}
+
+// Returns value length, or -1 if absent. *val_out is malloc'd; caller frees
+// via kv_free.
+int64_t kv_get(void* h, const uint8_t* key, uint32_t klen, uint8_t** val_out) {
+  DB* db = static_cast<DB*>(h);
+  std::lock_guard<std::mutex> g(db->mu);
+  auto it = db->map.find(std::string(reinterpret_cast<const char*>(key), klen));
+  if (it == db->map.end()) return -1;
+  *val_out = static_cast<uint8_t*>(malloc(it->second.size()));
+  memcpy(*val_out, it->second.data(), it->second.size());
+  return static_cast<int64_t>(it->second.size());
+}
+
+int kv_exists(void* h, const uint8_t* key, uint32_t klen) {
+  DB* db = static_cast<DB*>(h);
+  std::lock_guard<std::mutex> g(db->mu);
+  return db->map.count(std::string(reinterpret_cast<const char*>(key), klen)) ? 1 : 0;
+}
+
+void kv_free(uint8_t* p) { free(p); }
+
+int kv_sync(void* h) {
+  DB* db = static_cast<DB*>(h);
+  std::lock_guard<std::mutex> g(db->mu);
+  return fdatasync(db->wal_fd) == 0 ? 0 : -1;
+}
+
+uint64_t kv_count(void* h) {
+  DB* db = static_cast<DB*>(h);
+  std::lock_guard<std::mutex> g(db->mu);
+  return db->map.size();
+}
+
+// Persist the live map as a snapshot and truncate the WAL. Frees the space
+// held by deleted/overwritten entries (KeyValueStore::compact).
+int kv_compact(void* h) {
+  DB* db = static_cast<DB*>(h);
+  std::lock_guard<std::mutex> g(db->mu);
+  std::string payload;
+  for (auto& kv : db->map) {
+    payload.push_back(static_cast<char>(OP_PUT));
+    put_u32(payload, static_cast<uint32_t>(kv.first.size()));
+    payload += kv.first;
+    put_u32(payload, static_cast<uint32_t>(kv.second.size()));
+    payload += kv.second;
+  }
+  std::string tmp_path = db->snap_path() + ".tmp";
+  int fd = ::open(tmp_path.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return -1;
+  std::string rec;
+  put_u32(rec, SNAP_MAGIC);
+  put_u32(rec, static_cast<uint32_t>(payload.size()));
+  rec += payload;
+  put_u32(rec, crc32(reinterpret_cast<const uint8_t*>(payload.data()),
+                     payload.size()));
+  bool ok = write_all(fd, rec.data(), rec.size()) && fdatasync(fd) == 0;
+  ::close(fd);
+  if (!ok) return -1;
+  if (::rename(tmp_path.c_str(), db->snap_path().c_str()) != 0) return -1;
+  // WAL is now redundant.
+  ::close(db->wal_fd);
+  db->wal_fd = ::open(db->wal_path().c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  return db->wal_fd >= 0 ? 0 : -1;
+}
+
+// Ordered scan: all entries with key >= from and key starting with prefix.
+// Snapshot semantics (copies out under the lock).
+void* kv_iter_new(void* h, const uint8_t* from, uint32_t from_len,
+                  const uint8_t* prefix, uint32_t prefix_len) {
+  DB* db = static_cast<DB*>(h);
+  std::lock_guard<std::mutex> g(db->mu);
+  Iter* it = new Iter();
+  std::string start(reinterpret_cast<const char*>(from), from_len);
+  std::string pfx(reinterpret_cast<const char*>(prefix), prefix_len);
+  for (auto m = db->map.lower_bound(start); m != db->map.end(); ++m) {
+    if (!pfx.empty() &&
+        (m->first.size() < pfx.size() || m->first.compare(0, pfx.size(), pfx) != 0))
+      break;
+    it->items.emplace_back(m->first, m->second);
+  }
+  return it;
+}
+
+// Fills key/value pointers (valid until the next call / iter free).
+// Returns 1 on success, 0 at end.
+int kv_iter_next(void* hi, const uint8_t** key, uint32_t* klen,
+                 const uint8_t** val, uint32_t* vlen) {
+  Iter* it = static_cast<Iter*>(hi);
+  if (it->pos >= it->items.size()) return 0;
+  auto& kv = it->items[it->pos++];
+  *key = reinterpret_cast<const uint8_t*>(kv.first.data());
+  *klen = static_cast<uint32_t>(kv.first.size());
+  *val = reinterpret_cast<const uint8_t*>(kv.second.data());
+  *vlen = static_cast<uint32_t>(kv.second.size());
+  return 1;
+}
+
+void kv_iter_free(void* hi) { delete static_cast<Iter*>(hi); }
+
+}  // extern "C"

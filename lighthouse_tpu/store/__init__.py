@@ -1,0 +1,27 @@
+"""Storage layer: column KV seam + hot/cold split store.
+
+Reference: beacon_node/store (SURVEY.md §1 L5). Native backend:
+lighthouse_tpu/native/src/kvstore.cpp (the leveldb equivalent).
+"""
+
+from .kv import DBColumn, KeyValueStore, MemoryStore, NativeStore, StoreError
+from .hot_cold import (
+    AnchorInfo,
+    HotColdDB,
+    HotStateSummary,
+    Split,
+    StoreConfig,
+)
+
+__all__ = [
+    "AnchorInfo",
+    "DBColumn",
+    "HotColdDB",
+    "HotStateSummary",
+    "KeyValueStore",
+    "MemoryStore",
+    "NativeStore",
+    "Split",
+    "StoreConfig",
+    "StoreError",
+]

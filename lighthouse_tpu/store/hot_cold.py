@@ -1,0 +1,449 @@
+"""HotColdDB — the hot/cold split store.
+
+Mirror of beacon_node/store/src/hot_cold_store.rs:50: three column stores —
+hot (recent blocks + states), cold "freezer" (finalized history as chunked
+root vectors + sparse restore-point states), and blobs. Hot states are
+stored in full at epoch boundaries; other slots get a `HotStateSummary`
+(slot, latest_block_root, epoch_boundary_state_root) and are reconstructed
+by replaying blocks from the boundary state (hot_cold_store.rs
+put_state/get_state + state summary scheme). Finalized history migrates to
+the freezer: block/state roots into fixed-size chunks (chunked_vector.rs),
+full states every `slots_per_restore_point`, hot entries pruned.
+
+Replay runs the state transition with signatures off (the blocks being
+replayed were verified on import) and without state-root checks.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from lighthouse_tpu.state_transition import block_processing as bp
+from lighthouse_tpu.state_transition import slot_processing as sp
+from lighthouse_tpu.types.spec import ForkName
+
+from .kv import DBColumn, KeyValueStore, MemoryStore, StoreError
+
+# Roots per cold chunk (chunked_vector.rs stores 128-root columns).
+CHUNK_SIZE = 128
+
+_FORK_TAGS = {
+    ForkName.BASE: 0,
+    ForkName.ALTAIR: 1,
+    ForkName.BELLATRIX: 2,
+    ForkName.CAPELLA: 3,
+    ForkName.DENEB: 4,
+}
+_TAG_FORKS = {v: k for k, v in _FORK_TAGS.items()}
+
+
+@dataclass
+class StoreConfig:
+    slots_per_restore_point: int = 8192
+    epochs_per_state_diff: int = 1  # hot boundary-state cadence (epochs)
+    compact_on_prune: bool = False
+
+
+@dataclass
+class HotStateSummary:
+    slot: int
+    latest_block_root: bytes
+    epoch_boundary_state_root: bytes
+
+    def to_bytes(self) -> bytes:
+        return struct.pack("<Q", self.slot) + self.latest_block_root + \
+            self.epoch_boundary_state_root
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "HotStateSummary":
+        return cls(struct.unpack("<Q", b[:8])[0], b[8:40], b[40:72])
+
+
+@dataclass
+class Split:
+    """Hot/cold boundary (hot_cold_store.rs `Split`)."""
+
+    slot: int = 0
+    state_root: bytes = b"\x00" * 32
+
+    def to_bytes(self) -> bytes:
+        return struct.pack("<Q", self.slot) + self.state_root
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "Split":
+        return cls(struct.unpack("<Q", b[:8])[0], b[8:40])
+
+
+@dataclass
+class AnchorInfo:
+    """Checkpoint-sync anchor (metadata.rs AnchorInfo): the backfill frontier."""
+
+    anchor_slot: int
+    oldest_block_slot: int
+    oldest_block_parent: bytes
+
+    def to_bytes(self) -> bytes:
+        return struct.pack("<QQ", self.anchor_slot, self.oldest_block_slot) + \
+            self.oldest_block_parent
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "AnchorInfo":
+        a, o = struct.unpack("<QQ", b[:16])
+        return cls(a, o, b[16:48])
+
+
+_SPLIT_KEY = b"split"
+_ANCHOR_KEY = b"anchor"
+_GENESIS_BLOCK_ROOT_KEY = b"genesis_block_root"
+
+
+def _slot_key(slot: int) -> bytes:
+    return struct.pack(">Q", slot)  # big-endian so byte order == numeric order
+
+
+class HotColdDB:
+    def __init__(
+        self,
+        types,
+        spec,
+        hot: Optional[KeyValueStore] = None,
+        cold: Optional[KeyValueStore] = None,
+        blobs: Optional[KeyValueStore] = None,
+        config: Optional[StoreConfig] = None,
+    ):
+        self.types = types
+        self.spec = spec
+        # `is not None` matters: an empty NativeStore is falsy (__len__ == 0).
+        self.hot = hot if hot is not None else MemoryStore()
+        self.cold = cold if cold is not None else MemoryStore()
+        self.blobs_db = blobs if blobs is not None else MemoryStore()
+        self.config = config or StoreConfig()
+        raw = self.hot.get(DBColumn.BeaconMeta, _SPLIT_KEY)
+        self.split = Split.from_bytes(raw) if raw else Split()
+
+    @classmethod
+    def open(cls, path: str, types, spec, config: Optional[StoreConfig] = None):
+        """Disk-backed store: three native column DBs under `path`."""
+        from .kv import NativeStore
+
+        return cls(
+            types,
+            spec,
+            hot=NativeStore(path + "/hot"),
+            cold=NativeStore(path + "/cold"),
+            blobs=NativeStore(path + "/blobs"),
+            config=config,
+        )
+
+    def close(self):
+        for db in (self.hot, self.cold, self.blobs_db):
+            db.close()
+
+    # -- fork tagging -------------------------------------------------------
+
+    def _fork_at_slot(self, slot: int) -> str:
+        return self.spec.fork_name_at_epoch(self.spec.epoch_at_slot(slot))
+
+    # -- blocks -------------------------------------------------------------
+
+    def block_put_ops(self, block_root: bytes, signed_block) -> List[tuple]:
+        fork = self._fork_at_slot(signed_block.message.slot)
+        cls = self.types.SignedBeaconBlock[fork]
+        data = bytes([_FORK_TAGS[fork]]) + cls.serialize(signed_block)
+        return [("put", DBColumn.BeaconBlock, block_root, data)]
+
+    def put_block(self, block_root: bytes, signed_block) -> None:
+        self.hot.do_atomically(self.block_put_ops(block_root, signed_block))
+
+    def get_block(self, block_root: bytes):
+        data = self.hot.get(DBColumn.BeaconBlock, block_root)
+        if data is None:
+            return None
+        fork = _TAG_FORKS[data[0]]
+        return self.types.SignedBeaconBlock[fork].deserialize(data[1:])
+
+    def block_exists(self, block_root: bytes) -> bool:
+        return self.hot.exists(DBColumn.BeaconBlock, block_root)
+
+    def delete_block(self, block_root: bytes) -> None:
+        self.hot.delete(DBColumn.BeaconBlock, block_root)
+        self.blobs_db.delete(DBColumn.BeaconBlob, block_root)
+
+    # -- blobs --------------------------------------------------------------
+
+    def put_blobs(self, block_root: bytes, blob_sidecars_ssz: bytes) -> None:
+        self.blobs_db.put(DBColumn.BeaconBlob, block_root, blob_sidecars_ssz)
+
+    def get_blobs(self, block_root: bytes) -> Optional[bytes]:
+        return self.blobs_db.get(DBColumn.BeaconBlob, block_root)
+
+    # -- hot states ---------------------------------------------------------
+
+    def _serialize_state(self, state, fork: str) -> bytes:
+        cls = self.types.BeaconState[fork]
+        return bytes([_FORK_TAGS[fork]]) + cls.serialize(state)
+
+    def _deserialize_state(self, data: bytes):
+        fork = _TAG_FORKS[data[0]]
+        return self.types.BeaconState[fork].deserialize(data[1:])
+
+    def state_put_ops(self, state_root: bytes, state) -> List[tuple]:
+        """Summary always; full SSZ at epoch boundaries (the replay anchors)."""
+        P = self.spec.preset
+        fork = self._fork_at_slot(state.slot)
+        slot = state.slot
+        if slot % P.SLOTS_PER_EPOCH == 0:
+            boundary_root = state_root
+        else:
+            # Epoch-boundary state root is in the circular state_roots vector
+            # as long as the state is < SLOTS_PER_HISTORICAL_ROOT past it.
+            boundary_slot = slot - slot % P.SLOTS_PER_EPOCH
+            boundary_root = bytes(
+                state.state_roots[boundary_slot % P.SLOTS_PER_HISTORICAL_ROOT]
+            )
+        latest_block_root = self.types.BeaconBlockHeader.hash_tree_root(
+            state.latest_block_header
+        ) if bytes(state.latest_block_header.state_root) != b"\x00" * 32 else \
+            self._header_root_with_state_root(state, state_root)
+        summary = HotStateSummary(slot, latest_block_root, boundary_root)
+        ops = [("put", DBColumn.BeaconStateSummary, state_root, summary.to_bytes())]
+        if slot % (P.SLOTS_PER_EPOCH * self.config.epochs_per_state_diff) == 0:
+            ops.append(
+                ("put", DBColumn.BeaconState, state_root,
+                 self._serialize_state(state, fork))
+            )
+        return ops
+
+    def _header_root_with_state_root(self, state, state_root: bytes) -> bytes:
+        # latest_block_header.state_root is zeroed between the block and the
+        # next process_slot; patch it the way the spec's canonical root does.
+        hdr = state.latest_block_header.copy()
+        hdr.state_root = state_root
+        return self.types.BeaconBlockHeader.hash_tree_root(hdr)
+
+    def put_state(self, state_root: bytes, state) -> None:
+        self.hot.do_atomically(self.state_put_ops(state_root, state))
+
+    def get_hot_summary(self, state_root: bytes) -> Optional[HotStateSummary]:
+        raw = self.hot.get(DBColumn.BeaconStateSummary, state_root)
+        return HotStateSummary.from_bytes(raw) if raw else None
+
+    def get_state(self, state_root: bytes, slot: Optional[int] = None):
+        """Load a hot state: directly if stored in full, else replay from its
+        epoch-boundary anchor."""
+        data = self.hot.get(DBColumn.BeaconState, state_root)
+        if data is not None:
+            return self._deserialize_state(data)
+        summary = self.get_hot_summary(state_root)
+        if summary is None:
+            return None
+        anchor_raw = self.hot.get(
+            DBColumn.BeaconState, summary.epoch_boundary_state_root
+        )
+        if anchor_raw is None:
+            return None
+        state = self._deserialize_state(anchor_raw)
+        blocks = self._blocks_to_replay(
+            state.slot, summary.slot, summary.latest_block_root
+        )
+        return self._replay_blocks(state, blocks, summary.slot)
+
+    def state_exists(self, state_root: bytes) -> bool:
+        return self.hot.exists(DBColumn.BeaconStateSummary, state_root) or \
+            self.hot.exists(DBColumn.BeaconState, state_root)
+
+    def delete_state(self, state_root: bytes) -> None:
+        self.hot.do_atomically([
+            ("del", DBColumn.BeaconStateSummary, state_root),
+            ("del", DBColumn.BeaconState, state_root),
+        ])
+
+    # -- replay (the state reconstruction engine) ---------------------------
+
+    def _blocks_to_replay(
+        self, from_slot: int, to_slot: int, end_block_root: bytes
+    ) -> List:
+        """Blocks with from_slot < slot <= to_slot on the chain ending at
+        end_block_root, ascending. Walks parent_root links backwards."""
+        blocks = []
+        root = end_block_root
+        while True:
+            block = self.get_block(root)
+            if block is None:
+                break
+            msg = block.message
+            if msg.slot <= from_slot:
+                break
+            if msg.slot <= to_slot:
+                blocks.append(block)
+            root = bytes(msg.parent_root)
+            if msg.slot == 0:
+                break
+        blocks.reverse()
+        return blocks
+
+    def _replay_blocks(self, state, blocks: List, target_slot: int):
+        types, spec = self.types, self.spec
+        for signed_block in blocks:
+            block = signed_block.message
+            fork = self._fork_at_slot(block.slot)
+            sp.process_slots(state, types, spec, block.slot, fork=fork)
+            bp.per_block_processing(
+                state, types, spec, signed_block, fork,
+                verify_signatures=bp.VerifySignatures.FALSE,
+            )
+        if state.slot < target_slot:
+            sp.process_slots(
+                state, types, spec, target_slot,
+                fork=self._fork_at_slot(target_slot),
+            )
+        return state
+
+    # -- metadata -----------------------------------------------------------
+
+    def put_split(self, split: Split) -> None:
+        self.split = split
+        self.hot.put(DBColumn.BeaconMeta, _SPLIT_KEY, split.to_bytes(), sync=True)
+
+    def get_anchor_info(self) -> Optional[AnchorInfo]:
+        raw = self.hot.get(DBColumn.BeaconMeta, _ANCHOR_KEY)
+        return AnchorInfo.from_bytes(raw) if raw else None
+
+    def put_anchor_info(self, anchor: AnchorInfo) -> None:
+        self.hot.put(DBColumn.BeaconMeta, _ANCHOR_KEY, anchor.to_bytes())
+
+    def put_genesis_block_root(self, root: bytes) -> None:
+        self.hot.put(DBColumn.BeaconMeta, _GENESIS_BLOCK_ROOT_KEY, root)
+
+    def get_genesis_block_root(self) -> Optional[bytes]:
+        return self.hot.get(DBColumn.BeaconMeta, _GENESIS_BLOCK_ROOT_KEY)
+
+    # -- freezer ------------------------------------------------------------
+
+    def _chunk_get(self, column: str, chunk_idx: int) -> bytearray:
+        raw = self.cold.get(column, _slot_key(chunk_idx))
+        return bytearray(raw) if raw else bytearray(32 * CHUNK_SIZE)
+
+    def _root_at_cold_slot(self, column: str, slot: int) -> Optional[bytes]:
+        chunk = self.cold.get(column, _slot_key(slot // CHUNK_SIZE))
+        if chunk is None:
+            return None
+        off = (slot % CHUNK_SIZE) * 32
+        root = bytes(chunk[off:off + 32])
+        return None if root == b"\x00" * 32 else root
+
+    def get_cold_block_root(self, slot: int) -> Optional[bytes]:
+        return self._root_at_cold_slot(DBColumn.BeaconBlockRoots, slot)
+
+    def get_cold_state_root(self, slot: int) -> Optional[bytes]:
+        return self._root_at_cold_slot(DBColumn.BeaconStateRoots, slot)
+
+    def migrate_to_freezer(self, finalized_state, finalized_state_root: bytes) -> None:
+        """Move [split.slot, finalized_slot) roots into cold chunked vectors,
+        write restore-point states, prune hot states below the new split
+        (migrate.rs:33 responsibility; fork pruning lives in beacon_chain)."""
+        P = self.spec.preset
+        fin_slot = finalized_state.slot
+        if fin_slot <= self.split.slot:
+            return
+        # Root vectors ride along in the finalized state's circular buffers
+        # (valid for the most recent SLOTS_PER_HISTORICAL_ROOT slots).
+        if fin_slot - self.split.slot > P.SLOTS_PER_HISTORICAL_ROOT:
+            raise StoreError("freezer migration window exceeds historical roots")
+
+        ops = []
+        touched = {}
+        for slot in range(self.split.slot, fin_slot):
+            idx = slot % P.SLOTS_PER_HISTORICAL_ROOT
+            for column, vec in (
+                (DBColumn.BeaconBlockRoots, finalized_state.block_roots),
+                (DBColumn.BeaconStateRoots, finalized_state.state_roots),
+            ):
+                chunk_idx = slot // CHUNK_SIZE
+                key = (column, chunk_idx)
+                if key not in touched:
+                    touched[key] = self._chunk_get(column, chunk_idx)
+                off = (slot % CHUNK_SIZE) * 32
+                touched[key][off:off + 32] = bytes(vec[idx])
+        for (column, chunk_idx), chunk in touched.items():
+            ops.append(("put", column, _slot_key(chunk_idx), bytes(chunk)))
+
+        # Restore points: full cold states on the configured cadence.
+        spr = self.config.slots_per_restore_point
+        for slot in range(self.split.slot, fin_slot):
+            if slot % spr == 0:
+                sroot = self._root_at_cold_slot_pending(
+                    touched, finalized_state, slot, P
+                )
+                if sroot is None:
+                    continue
+                state = self.get_state(sroot)
+                if state is not None:
+                    ops.append((
+                        "put", DBColumn.BeaconRestorePoint, _slot_key(slot),
+                        self._serialize_state(state, self._fork_at_slot(slot)),
+                    ))
+        self.cold.do_atomically(ops, sync=True)
+
+        # Prune hot states strictly below the new split.
+        delete = []
+        for state_root, raw in list(
+            self.hot.iter_column_from(DBColumn.BeaconStateSummary)
+        ):
+            summary = HotStateSummary.from_bytes(raw)
+            if summary.slot < fin_slot and state_root != finalized_state_root:
+                delete.append(("del", DBColumn.BeaconStateSummary, state_root))
+                delete.append(("del", DBColumn.BeaconState, state_root))
+        self.hot.do_atomically(delete)
+        self.put_split(Split(fin_slot, finalized_state_root))
+        if self.config.compact_on_prune:
+            self.hot.compact()
+
+    @staticmethod
+    def _root_at_cold_slot_pending(touched, state, slot: int, P) -> Optional[bytes]:
+        chunk = touched.get((DBColumn.BeaconStateRoots, slot // CHUNK_SIZE))
+        if chunk is None:
+            return None
+        off = (slot % CHUNK_SIZE) * 32
+        root = bytes(chunk[off:off + 32])
+        return None if root == b"\x00" * 32 else root
+
+    def load_cold_state_by_slot(self, slot: int):
+        """Nearest restore point at/below `slot`, replayed forward
+        (reconstruct.rs / chunked_iter.rs analog)."""
+        spr = self.config.slots_per_restore_point
+        rp_slot = slot - slot % spr
+        raw = self.cold.get(DBColumn.BeaconRestorePoint, _slot_key(rp_slot))
+        if raw is None:
+            return None
+        state = self._deserialize_state(raw)
+        if state.slot == slot:
+            return state
+        # Find the last block at/below `slot` via the cold block-root chunks.
+        end_root = None
+        s = slot
+        while s > rp_slot and end_root is None:
+            end_root = self.get_cold_block_root(s)
+            s -= 1
+        if end_root is None:
+            end_root = self.types.BeaconBlockHeader.hash_tree_root(
+                state.latest_block_header
+            )
+        blocks = self._blocks_to_replay(state.slot, slot, end_root)
+        return self._replay_blocks(state, blocks, slot)
+
+    # -- iteration ----------------------------------------------------------
+
+    def iter_block_roots_back(self, head_block_root: bytes):
+        """(block_root, slot) descending via parent links (iter.rs analog)."""
+        root = head_block_root
+        while True:
+            block = self.get_block(root)
+            if block is None:
+                return
+            yield root, block.message.slot
+            if block.message.slot == 0:
+                return
+            root = bytes(block.message.parent_root)

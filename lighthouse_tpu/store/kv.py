@@ -1,0 +1,260 @@
+"""Key-value store seam: column-oriented ordered KV with atomic batches.
+
+Mirrors the reference's `KeyValueStore`/`ItemStore` trait surface
+(beacon_node/store/src/lib.rs:53-153) and its column scheme (keys are
+`column-prefix || key`, lib.rs:140-144). Two backends:
+
+  * `MemoryStore` — dict-backed, for tests (memory_store.rs analog);
+  * `NativeStore` — the C++ LSM-lite engine (native/src/kvstore.cpp), the
+    leveldb_store.rs analog: durable WAL, CRC-framed atomic batches,
+    ordered iteration, compaction.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import struct
+import threading
+from typing import Iterator, List, Optional, Tuple
+
+
+class StoreError(Exception):
+    pass
+
+
+class DBColumn:
+    """Column prefixes (3-byte, reference lib.rs:216-310 naming scheme)."""
+
+    BeaconMeta = "bma"
+    BeaconBlock = "blk"
+    BeaconBlob = "blb"
+    BeaconState = "ste"
+    BeaconStateSummary = "bss"
+    BeaconStateTemporary = "bst"
+    BeaconRestorePoint = "brp"
+    BeaconBlockRoots = "bbr"
+    BeaconStateRoots = "bsr"
+    BeaconHistoricalRoots = "bhr"
+    BeaconHistoricalSummaries = "bhs"
+    BeaconRandaoMixes = "brm"
+    ForkChoice = "frc"
+    PubkeyCache = "pkc"
+    OpPool = "opo"
+    Eth1Cache = "etc"
+    DhtEnrs = "dht"
+    ExecPayload = "exp"
+    ValidatorInfo = "vdi"
+
+
+# Atomic-batch ops: ("put", column, key, value) | ("del", column, key).
+PutOp = Tuple[str, str, bytes, bytes]
+DelOp = Tuple[str, str, bytes]
+
+
+def column_key(column: str, key: bytes) -> bytes:
+    return column.encode("ascii") + key
+
+
+class KeyValueStore:
+    """Abstract column KV interface (get/put/delete/exists/batch/iter)."""
+
+    def get(self, column: str, key: bytes) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def put(self, column: str, key: bytes, value: bytes, sync: bool = False) -> None:
+        self.do_atomically([("put", column, key, value)], sync=sync)
+
+    def delete(self, column: str, key: bytes) -> None:
+        self.do_atomically([("del", column, key)])
+
+    def exists(self, column: str, key: bytes) -> bool:
+        return self.get(column, key) is not None
+
+    def do_atomically(self, ops: List[tuple], sync: bool = False) -> None:
+        raise NotImplementedError
+
+    def iter_column_from(
+        self, column: str, start_key: bytes = b""
+    ) -> Iterator[Tuple[bytes, bytes]]:
+        """Ordered (key, value) pairs of `column`, keys >= start_key."""
+        raise NotImplementedError
+
+    def iter_column_keys(self, column: str) -> Iterator[bytes]:
+        for k, _ in self.iter_column_from(column):
+            yield k
+
+    def sync(self) -> None:
+        pass
+
+    def compact(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class MemoryStore(KeyValueStore):
+    def __init__(self):
+        self._map = {}
+        self._lock = threading.Lock()
+
+    def get(self, column: str, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self._map.get(column_key(column, key))
+
+    def do_atomically(self, ops: List[tuple], sync: bool = False) -> None:
+        with self._lock:
+            for op in ops:
+                if op[0] == "put":
+                    _, col, key, value = op
+                    self._map[column_key(col, key)] = bytes(value)
+                elif op[0] == "del":
+                    _, col, key = op
+                    self._map.pop(column_key(col, key), None)
+                else:
+                    raise StoreError(f"unknown op {op[0]}")
+
+    def iter_column_from(self, column: str, start_key: bytes = b""):
+        prefix = column.encode("ascii")
+        start = column_key(column, start_key)
+        with self._lock:
+            items = sorted(
+                (k, v) for k, v in self._map.items()
+                if k.startswith(prefix) and k >= start
+            )
+        for k, v in items:
+            yield k[len(prefix):], v
+
+
+class NativeStore(KeyValueStore):
+    """ctypes binding to the C++ engine."""
+
+    def __init__(self, path: str):
+        import os
+
+        from lighthouse_tpu import native
+
+        os.makedirs(path, exist_ok=True)
+
+        self._lib = native.load("kvstore")
+        lib = self._lib
+        lib.kv_open.restype = ctypes.c_void_p
+        lib.kv_open.argtypes = [ctypes.c_char_p]
+        lib.kv_close.argtypes = [ctypes.c_void_p]
+        lib.kv_apply_batch.restype = ctypes.c_int
+        lib.kv_apply_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_int,
+        ]
+        lib.kv_get.restype = ctypes.c_int64
+        lib.kv_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
+        ]
+        lib.kv_exists.restype = ctypes.c_int
+        lib.kv_exists.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+        lib.kv_free.argtypes = [ctypes.POINTER(ctypes.c_ubyte)]
+        lib.kv_sync.restype = ctypes.c_int
+        lib.kv_sync.argtypes = [ctypes.c_void_p]
+        lib.kv_compact.restype = ctypes.c_int
+        lib.kv_compact.argtypes = [ctypes.c_void_p]
+        lib.kv_count.restype = ctypes.c_uint64
+        lib.kv_count.argtypes = [ctypes.c_void_p]
+        lib.kv_iter_new.restype = ctypes.c_void_p
+        lib.kv_iter_new.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.c_char_p, ctypes.c_uint32,
+        ]
+        lib.kv_iter_next.restype = ctypes.c_int
+        lib.kv_iter_next.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
+        lib.kv_iter_free.argtypes = [ctypes.c_void_p]
+
+        self._db = lib.kv_open(path.encode())
+        if not self._db:
+            raise StoreError(f"failed to open kvstore at {path}")
+        self._closed = False
+
+    def close(self) -> None:
+        if not self._closed:
+            self._lib.kv_close(self._db)
+            self._closed = True
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    @staticmethod
+    def _encode_batch(ops: List[tuple]) -> bytes:
+        out = bytearray()
+        for op in ops:
+            if op[0] == "put":
+                _, col, key, value = op
+                k = column_key(col, key)
+                out += b"\x01" + struct.pack("<I", len(k)) + k
+                out += struct.pack("<I", len(value)) + bytes(value)
+            elif op[0] == "del":
+                _, col, key = op
+                k = column_key(col, key)
+                out += b"\x02" + struct.pack("<I", len(k)) + k
+            else:
+                raise StoreError(f"unknown op {op[0]}")
+        return bytes(out)
+
+    def do_atomically(self, ops: List[tuple], sync: bool = False) -> None:
+        payload = self._encode_batch(ops)
+        rc = self._lib.kv_apply_batch(self._db, payload, len(payload), int(sync))
+        if rc != 0:
+            raise StoreError(f"batch failed rc={rc}")
+
+    def get(self, column: str, key: bytes) -> Optional[bytes]:
+        k = column_key(column, key)
+        out = ctypes.POINTER(ctypes.c_ubyte)()
+        n = self._lib.kv_get(self._db, k, len(k), ctypes.byref(out))
+        if n < 0:
+            return None
+        try:
+            return ctypes.string_at(out, n)
+        finally:
+            self._lib.kv_free(out)
+
+    def exists(self, column: str, key: bytes) -> bool:
+        k = column_key(column, key)
+        return bool(self._lib.kv_exists(self._db, k, len(k)))
+
+    def iter_column_from(self, column: str, start_key: bytes = b""):
+        prefix = column.encode("ascii")
+        start = column_key(column, start_key)
+        it = self._lib.kv_iter_new(self._db, start, len(start), prefix, len(prefix))
+        try:
+            kp = ctypes.POINTER(ctypes.c_ubyte)()
+            kl = ctypes.c_uint32()
+            vp = ctypes.POINTER(ctypes.c_ubyte)()
+            vl = ctypes.c_uint32()
+            while self._lib.kv_iter_next(
+                it, ctypes.byref(kp), ctypes.byref(kl), ctypes.byref(vp),
+                ctypes.byref(vl),
+            ):
+                yield (
+                    ctypes.string_at(kp, kl.value)[len(prefix):],
+                    ctypes.string_at(vp, vl.value),
+                )
+        finally:
+            self._lib.kv_iter_free(it)
+
+    def sync(self) -> None:
+        if self._lib.kv_sync(self._db) != 0:
+            raise StoreError("sync failed")
+
+    def compact(self) -> None:
+        if self._lib.kv_compact(self._db) != 0:
+            raise StoreError("compact failed")
+
+    def __len__(self):
+        return int(self._lib.kv_count(self._db))

@@ -1,0 +1,293 @@
+"""Storage layer tests: KV backends (memory + native C++), HotColdDB block
+and state storage, summary-replay state reconstruction, freezer migration.
+
+Models the reference's store tests (beacon_node/store/src/memory_store.rs
+unit tests + beacon_chain/tests/store_tests.rs shape, SURVEY.md §4).
+"""
+
+import pytest
+
+from lighthouse_tpu.crypto.bls import api as bls
+from lighthouse_tpu.state_transition import block_processing as bp
+from lighthouse_tpu.state_transition import genesis as gen
+from lighthouse_tpu.state_transition import helpers as h
+from lighthouse_tpu.state_transition import slot_processing as sp
+from lighthouse_tpu.store import (
+    DBColumn,
+    HotColdDB,
+    MemoryStore,
+    NativeStore,
+    StoreConfig,
+)
+from lighthouse_tpu.types.containers import make_types
+from lighthouse_tpu.types.spec import (
+    DOMAIN_RANDAO,
+    ForkName,
+    compute_signing_root,
+    get_domain,
+    minimal_spec,
+)
+
+FORK = ForkName.CAPELLA
+N_VALIDATORS = 64
+
+
+# ---------------------------------------------------------------------------
+# KV backends
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(params=["memory", "native"])
+def kv(request, tmp_path):
+    if request.param == "memory":
+        yield MemoryStore()
+    else:
+        store = NativeStore(str(tmp_path / "db"))
+        yield store
+        store.close()
+
+
+def test_kv_roundtrip(kv):
+    assert kv.get(DBColumn.BeaconBlock, b"k1") is None
+    kv.put(DBColumn.BeaconBlock, b"k1", b"v1")
+    assert kv.get(DBColumn.BeaconBlock, b"k1") == b"v1"
+    assert kv.exists(DBColumn.BeaconBlock, b"k1")
+    # column isolation: same key, different column
+    assert kv.get(DBColumn.BeaconState, b"k1") is None
+    kv.put(DBColumn.BeaconBlock, b"k1", b"v2")
+    assert kv.get(DBColumn.BeaconBlock, b"k1") == b"v2"
+    kv.delete(DBColumn.BeaconBlock, b"k1")
+    assert not kv.exists(DBColumn.BeaconBlock, b"k1")
+
+
+def test_kv_atomic_batch_and_iteration(kv):
+    ops = [("put", DBColumn.BeaconBlock, bytes([i]), bytes([i]) * 3) for i in range(5)]
+    ops.append(("del", DBColumn.BeaconBlock, bytes([1])))
+    kv.do_atomically(ops)
+    items = list(kv.iter_column_from(DBColumn.BeaconBlock))
+    assert [k for k, _ in items] == [bytes([0]), bytes([2]), bytes([3]), bytes([4])]
+    assert items[1][1] == bytes([2]) * 3
+    # start-key slicing
+    items = list(kv.iter_column_from(DBColumn.BeaconBlock, bytes([3])))
+    assert [k for k, _ in items] == [bytes([3]), bytes([4])]
+
+
+def test_native_durability_and_compaction(tmp_path):
+    path = str(tmp_path / "db")
+    store = NativeStore(path)
+    store.put(DBColumn.BeaconBlock, b"a", b"1", sync=True)
+    store.do_atomically(
+        [("put", DBColumn.BeaconState, b"b", b"2" * 100),
+         ("put", DBColumn.BeaconState, b"c", b"3")],
+        sync=True,
+    )
+    store.close()
+
+    # WAL replay on reopen.
+    store = NativeStore(path)
+    assert store.get(DBColumn.BeaconBlock, b"a") == b"1"
+    assert store.get(DBColumn.BeaconState, b"b") == b"2" * 100
+    store.delete(DBColumn.BeaconState, b"b")
+    store.compact()
+    store.close()
+
+    # Snapshot load after compaction (WAL truncated).
+    store = NativeStore(path)
+    assert store.get(DBColumn.BeaconBlock, b"a") == b"1"
+    assert store.get(DBColumn.BeaconState, b"b") is None
+    assert store.get(DBColumn.BeaconState, b"c") == b"3"
+    store.close()
+
+
+# ---------------------------------------------------------------------------
+# Chain fixture (signature-free blocks: store tests don't test crypto)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def chain():
+    spec = minimal_spec()
+    types = make_types(spec.preset)
+    keys = gen.generate_deterministic_keypairs(N_VALIDATORS)
+    state = gen.interop_genesis_state(types, spec, keys, genesis_time=1_600_000_000)
+    return {"spec": spec, "types": types, "keys": keys, "genesis": state}
+
+
+def _randao_reveal(chain, state, epoch, proposer_index):
+    spec, keys = chain["spec"], chain["keys"]
+    from lighthouse_tpu.types import ssz
+
+    domain = get_domain(
+        spec, DOMAIN_RANDAO, epoch,
+        state.fork.current_version, state.fork.previous_version,
+        state.fork.epoch, state.genesis_validators_root,
+    )
+    root = compute_signing_root(epoch, ssz.uint64, domain)
+    return keys[proposer_index].sign(root).to_bytes()
+
+
+def _make_block(chain, state, slot):
+    """Valid empty block at `slot` on top of `state`; returns (signed, post)."""
+    spec, types = chain["spec"], chain["types"]
+    work = state.copy()
+    sp.process_slots(work, types, spec, slot, fork=FORK)
+    proposer = h.get_beacon_proposer_index(work, spec)
+    epoch = spec.epoch_at_slot(slot)
+    payload = types.ExecutionPayloadCapella(
+        parent_hash=work.latest_execution_payload_header.block_hash,
+        prev_randao=h.get_randao_mix(work, spec, epoch),
+        block_number=work.latest_execution_payload_header.block_number + 1,
+        timestamp=work.genesis_time + slot * spec.seconds_per_slot,
+        block_hash=bytes([slot % 256]) * 32,
+        withdrawals=bp.get_expected_withdrawals(work, types, spec),
+    )
+    body = types.BeaconBlockBodyCapella(
+        randao_reveal=_randao_reveal(chain, work, epoch, proposer),
+        eth1_data=work.eth1_data,
+        graffiti=b"\x00" * 32,
+        sync_aggregate=types.SyncAggregate(
+            sync_committee_bits=[False] * spec.preset.SYNC_COMMITTEE_SIZE,
+            sync_committee_signature=bls.Signature.infinity().to_bytes(),
+        ),
+        execution_payload=payload,
+    )
+    block = types.BeaconBlock[FORK](
+        slot=slot,
+        proposer_index=proposer,
+        parent_root=types.BeaconBlockHeader.hash_tree_root(work.latest_block_header),
+        state_root=b"\x00" * 32,
+        body=body,
+    )
+    post = state.copy()
+    signed = types.SignedBeaconBlock[FORK](message=block, signature=b"\x00" * 96)
+    sp.state_transition(
+        post, types, spec, signed, FORK,
+        verify_signatures=bp.VerifySignatures.FALSE, verify_state_root=False,
+    )
+    block.state_root = types.BeaconState[FORK].hash_tree_root(post)
+    return signed, post
+
+
+@pytest.fixture(scope="module")
+def built_chain(chain):
+    """Blocks at slots 1..2*SLOTS_PER_EPOCH with their post-states."""
+    spec, types = chain["spec"], chain["types"]
+    state = chain["genesis"].copy()
+    out = []  # (block_root, signed_block, state_root, post_state)
+    n = 2 * spec.preset.SLOTS_PER_EPOCH
+    for slot in range(1, n + 1):
+        signed, post = _make_block(chain, state, slot)
+        root = types.BeaconBlock[FORK].hash_tree_root(signed.message)
+        out.append((root, signed, bytes(signed.message.state_root), post))
+        state = post
+    return out
+
+
+def _fresh_db(chain, **cfg):
+    return HotColdDB(chain["types"], chain["spec"], config=StoreConfig(**cfg))
+
+
+def _store_chain(db, chain, built_chain):
+    types, spec = chain["types"], chain["spec"]
+    genesis = chain["genesis"]
+    genesis_root = types.BeaconState[FORK].hash_tree_root(genesis)
+    db.put_state(genesis_root, genesis)
+    for root, signed, state_root, post in built_chain:
+        db.put_block(root, signed)
+        db.put_state(state_root, post)
+    return genesis_root
+
+
+def test_block_roundtrip(chain, built_chain):
+    db = _fresh_db(chain)
+    types = chain["types"]
+    root, signed, _, _ = built_chain[0]
+    db.put_block(root, signed)
+    got = db.get_block(root)
+    cls = types.SignedBeaconBlock[FORK]
+    assert cls.serialize(got) == cls.serialize(signed)
+    assert db.get_block(b"\xff" * 32) is None
+
+
+def test_state_summary_replay(chain, built_chain):
+    """Non-boundary states reconstruct bit-exactly from the epoch-boundary
+    anchor + block replay."""
+    db = _fresh_db(chain)
+    _store_chain(db, chain, built_chain)
+    types = chain["types"]
+    cls = types.BeaconState[FORK]
+    # slot 3 is mid-epoch: stored as summary only
+    root3, _, state_root3, post3 = built_chain[2]
+    assert db.hot.get(DBColumn.BeaconState, state_root3) is None
+    got = db.get_state(state_root3)
+    assert got is not None
+    assert cls.serialize(got) == cls.serialize(post3)
+
+
+def test_state_boundary_direct_load(chain, built_chain):
+    db = _fresh_db(chain)
+    _store_chain(db, chain, built_chain)
+    types, spec = chain["types"], chain["spec"]
+    cls = types.BeaconState[FORK]
+    per_epoch = spec.preset.SLOTS_PER_EPOCH
+    _, _, state_root, post = built_chain[per_epoch - 1]  # slot == SLOTS_PER_EPOCH
+    assert post.slot % per_epoch == 0
+    assert db.hot.get(DBColumn.BeaconState, state_root) is not None
+    got = db.get_state(state_root)
+    assert cls.serialize(got) == cls.serialize(post)
+
+
+def test_freezer_migration_and_cold_load(chain, built_chain):
+    db = _fresh_db(chain, slots_per_restore_point=8)
+    genesis_root = _store_chain(db, chain, built_chain)
+    types, spec = chain["types"], chain["spec"]
+    cls = types.BeaconState[FORK]
+    per_epoch = spec.preset.SLOTS_PER_EPOCH
+
+    # Treat the end of epoch 1 as finalized.
+    fin_idx = 2 * per_epoch - 1
+    _, _, fin_root, fin_state = built_chain[fin_idx]
+    db.migrate_to_freezer(fin_state, fin_root)
+    assert db.split.slot == fin_state.slot
+    assert db.split.state_root == fin_root
+
+    # Cold root vectors are populated.
+    root1, signed1, state_root1, _ = built_chain[0]
+    assert db.get_cold_block_root(1) == root1
+    assert db.get_cold_state_root(1) == state_root1
+
+    # Hot states below the split are pruned; finalized state stays.
+    assert not db.state_exists(state_root1)
+    assert db.state_exists(fin_root)
+
+    # Restore point at slot 8 exists (spr=8) and replays to slot 11.
+    _, _, sr11, post11 = built_chain[10]
+    got = db.load_cold_state_by_slot(11)
+    assert got is not None
+    assert cls.serialize(got) == cls.serialize(post11)
+
+
+def test_iter_block_roots_back(chain, built_chain):
+    db = _fresh_db(chain)
+    _store_chain(db, chain, built_chain)
+    head_root = built_chain[-1][0]
+    walked = list(db.iter_block_roots_back(head_root))
+    slots = [s for _, s in walked]
+    assert slots == list(range(len(built_chain), 0, -1))
+    assert walked[-1][0] == built_chain[0][0]
+
+
+def test_split_and_anchor_metadata(chain):
+    from lighthouse_tpu.store import AnchorInfo, Split
+
+    db = _fresh_db(chain)
+    db.put_split(Split(64, b"\x01" * 32))
+    db2 = HotColdDB(chain["types"], chain["spec"], hot=db.hot, cold=db.cold,
+                    blobs=db.blobs_db)
+    assert db2.split.slot == 64 and db2.split.state_root == b"\x01" * 32
+
+    assert db.get_anchor_info() is None
+    db.put_anchor_info(AnchorInfo(128, 100, b"\x02" * 32))
+    a = db.get_anchor_info()
+    assert (a.anchor_slot, a.oldest_block_slot) == (128, 100)
+    assert a.oldest_block_parent == b"\x02" * 32
