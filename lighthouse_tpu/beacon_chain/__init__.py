@@ -1,0 +1,42 @@
+"""Beacon chain core runtime (reference: beacon_node/beacon_chain, L4)."""
+
+from .chain import BeaconChain, CanonicalHead
+from .block_verification import (
+    BlockError,
+    ExecutionPendingBlock,
+    GossipVerifiedBlock,
+    SignatureVerifiedBlock,
+    gossip_verify_block,
+    into_execution_pending_block,
+    signature_verify_block,
+    verify_chain_segment,
+)
+from .attestation_verification import (
+    AttestationError,
+    VerifiedAggregatedAttestation,
+    VerifiedUnaggregatedAttestation,
+    batch_verify_aggregated_attestations,
+    batch_verify_unaggregated_attestations,
+    verify_aggregated_attestation,
+    verify_unaggregated_attestation,
+)
+
+__all__ = [
+    "AttestationError",
+    "BeaconChain",
+    "BlockError",
+    "CanonicalHead",
+    "ExecutionPendingBlock",
+    "GossipVerifiedBlock",
+    "SignatureVerifiedBlock",
+    "VerifiedAggregatedAttestation",
+    "VerifiedUnaggregatedAttestation",
+    "batch_verify_aggregated_attestations",
+    "batch_verify_unaggregated_attestations",
+    "gossip_verify_block",
+    "into_execution_pending_block",
+    "signature_verify_block",
+    "verify_aggregated_attestation",
+    "verify_chain_segment",
+    "verify_unaggregated_attestation",
+]

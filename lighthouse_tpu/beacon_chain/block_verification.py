@@ -1,0 +1,225 @@
+"""Block verification — the gossip -> signatures -> execution typestate.
+
+Mirror of beacon_chain/src/block_verification.rs: `GossipVerifiedBlock`
+(:643 — slot/parent/proposer checks + proposer signature only),
+`SignatureVerifiedBlock` (:652 — every other signature bulk-verified via
+the backend), `ExecutionPendingBlock` (:675 — state transition run, payload
+handed to the execution layer). `verify_chain_segment` is the range-sync
+bulk path (signature_verify_chain_segment :572): one backend call over all
+signatures of the whole segment.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+from lighthouse_tpu.crypto.bls import api as bls
+from lighthouse_tpu.state_transition import block_processing as bp
+from lighthouse_tpu.state_transition import signature_sets as sigsets
+from lighthouse_tpu.state_transition import slot_processing as sp
+from lighthouse_tpu.state_transition.block_signature_verifier import (
+    BlockSignatureVerifier,
+)
+
+
+class BlockError(Exception):
+    def __init__(self, kind: str, detail: str = ""):
+        self.kind = kind
+        super().__init__(f"{kind}{': ' + detail if detail else ''}")
+
+
+@dataclass
+class GossipVerifiedBlock:
+    signed_block: object
+    block_root: bytes
+
+
+@dataclass
+class SignatureVerifiedBlock:
+    signed_block: object
+    block_root: bytes
+    pre_state: object  # advanced to block.slot
+
+
+@dataclass
+class ExecutionPendingBlock:
+    signed_block: object
+    block_root: bytes
+    post_state: object
+    payload_status: str  # "valid" | "optimistic" | "irrelevant"
+
+
+def gossip_verify_block(chain, signed_block) -> GossipVerifiedBlock:
+    """Cheap structural checks + proposer signature
+    (GossipVerifiedBlock::new :770, proposer sig early :1057-1064)."""
+    block = signed_block.message
+    current = chain.current_slot()
+    if block.slot > current:
+        raise BlockError("FutureSlot", f"{block.slot} > {current}")
+    fin_slot = chain.spec.start_slot_of_epoch(chain.fork_choice.finalized.epoch)
+    if block.slot <= fin_slot:
+        raise BlockError("WouldRevertFinalizedSlot")
+
+    block_root = chain.types.BeaconBlock[chain.fork_at(block.slot)].hash_tree_root(
+        block
+    )
+    if chain.block_is_known(block_root):
+        raise BlockError("BlockIsAlreadyKnown", block_root.hex())
+    if chain.observed_block_producers.observe(
+        block.slot, block.proposer_index, block_root
+    ):
+        raise BlockError(
+            "RepeatProposal", f"proposer {block.proposer_index} slot {block.slot}"
+        )
+
+    parent_root = bytes(block.parent_root)
+    if not chain.block_is_known(parent_root):
+        raise BlockError("ParentUnknown", parent_root.hex())
+
+    # Proposer-index + signature check against the head state's shuffling.
+    state = chain.head_state_for_signatures()
+    epoch = chain.spec.epoch_at_slot(block.slot)
+    proposers = chain.proposer_cache.get_or_compute(
+        chain.head_state_clone_at(block.slot), chain.spec, epoch
+    )
+    expected = proposers[block.slot % chain.spec.preset.SLOTS_PER_EPOCH]
+    if block.proposer_index != expected:
+        raise BlockError(
+            "IncorrectBlockProposer", f"{block.proposer_index} != {expected}"
+        )
+    sset = sigsets.block_proposal_signature_set(
+        state, chain.types, chain.spec, signed_block, chain.fork_at(block.slot),
+        chain.pubkey_getter,
+    )
+    if not bls.verify_signature_sets([sset], backend=chain.bls_backend):
+        raise BlockError("ProposalSignatureInvalid")
+    return GossipVerifiedBlock(signed_block=signed_block, block_root=block_root)
+
+
+def signature_verify_block(
+    chain, gossip_verified: GossipVerifiedBlock, proposal_verified: bool = True
+) -> SignatureVerifiedBlock:
+    """Advance the parent state to block.slot and bulk-verify every remaining
+    signature in one backend call (SignatureVerifiedBlock + get_signature_verifier
+    :2063 wiring the pubkey cache)."""
+    signed_block = gossip_verified.signed_block
+    block = signed_block.message
+    parent_root = bytes(block.parent_root)
+
+    pre_state = chain.state_for_block_import(parent_root)
+    if pre_state is None:
+        raise BlockError("ParentUnknown", parent_root.hex())
+    fork = chain.fork_at(block.slot)
+    if pre_state.slot < block.slot:
+        sp.process_slots(pre_state, chain.types, chain.spec, block.slot, fork=fork)
+
+    verifier = BlockSignatureVerifier(
+        pre_state, chain.types, chain.spec, get_pubkey=chain.pubkey_getter
+    )
+    if proposal_verified:
+        verifier.include_all_signatures_except_proposal(signed_block.message, fork)
+    else:
+        verifier.include_all_signatures(signed_block, fork)
+    if not verifier.verify(backend=chain.bls_backend):
+        raise BlockError("InvalidSignature", "bulk signature verification failed")
+    return SignatureVerifiedBlock(
+        signed_block=signed_block,
+        block_root=gossip_verified.block_root,
+        pre_state=pre_state,
+    )
+
+
+def into_execution_pending_block(
+    chain, sig_verified: SignatureVerifiedBlock
+) -> ExecutionPendingBlock:
+    """Run the state transition (signatures already done) and notify the
+    execution layer of the payload (into_execution_pending_block :1001 +
+    notify_new_payload boundary)."""
+    signed_block = sig_verified.signed_block
+    block = signed_block.message
+    state = sig_verified.pre_state
+    fork = chain.fork_at(block.slot)
+
+    bp.per_block_processing(
+        state, chain.types, chain.spec, signed_block, fork,
+        verify_signatures=bp.VerifySignatures.FALSE,
+    )
+    root = chain.types.BeaconState[fork].hash_tree_root(state)
+    if bytes(block.state_root) != root:
+        raise BlockError("StateRootMismatch")
+
+    payload_status = "irrelevant"
+    if chain.execution_layer is not None and hasattr(block.body, "execution_payload"):
+        status = chain.execution_layer.notify_new_payload(
+            block.body.execution_payload
+        )
+        if status == "INVALID":
+            raise BlockError("ExecutionPayloadInvalid")
+        payload_status = "valid" if status == "VALID" else "optimistic"
+    return ExecutionPendingBlock(
+        signed_block=signed_block,
+        block_root=sig_verified.block_root,
+        post_state=state,
+        payload_status=payload_status,
+    )
+
+
+def verify_chain_segment(chain, blocks: List[object]) -> List[SignatureVerifiedBlock]:
+    """Range-sync bulk path: one backend call over every signature of the
+    segment (signature_verify_chain_segment :572, :620-626). Caller imports
+    the results in order with import_execution_pending."""
+    if not blocks:
+        return []
+    # Check linkage + ascending slots first (cheap).
+    for a, b in zip(blocks, blocks[1:]):
+        fork = chain.fork_at(a.message.slot)
+        root_a = chain.types.BeaconBlock[fork].hash_tree_root(a.message)
+        if bytes(b.message.parent_root) != root_a or b.message.slot <= a.message.slot:
+            raise BlockError("NonLinearSegment")
+
+    parent_root = bytes(blocks[0].message.parent_root)
+    state = chain.state_for_block_import(parent_root)
+    if state is None:
+        raise BlockError("ParentUnknown", parent_root.hex())
+
+    # Accumulate all sets while replaying the transitions on a scratch state.
+    scratch = state.copy()
+    all_sets = []
+    per_block_states = []
+    for signed_block in blocks:
+        block = signed_block.message
+        fork = chain.fork_at(block.slot)
+        if scratch.slot < block.slot:
+            sp.process_slots(scratch, chain.types, chain.spec, block.slot, fork=fork)
+        v = BlockSignatureVerifier(
+            scratch, chain.types, chain.spec, get_pubkey=chain.pubkey_getter
+        )
+        v.include_all_signatures(signed_block, fork)
+        all_sets.extend(v.sets)
+        pre = scratch.copy()
+        per_block_states.append(pre)
+        bp.per_block_processing(
+            scratch, chain.types, chain.spec, signed_block, fork,
+            verify_signatures=bp.VerifySignatures.FALSE,
+        )
+        root = chain.types.BeaconState[fork].hash_tree_root(scratch)
+        if bytes(block.state_root) != root:
+            raise BlockError("StateRootMismatch", f"slot {block.slot}")
+
+    if not bls.verify_signature_sets(all_sets, backend=chain.bls_backend):
+        raise BlockError("InvalidSignature", "segment bulk verification failed")
+
+    out = []
+    for signed_block, pre in zip(blocks, per_block_states):
+        fork = chain.fork_at(signed_block.message.slot)
+        out.append(
+            SignatureVerifiedBlock(
+                signed_block=signed_block,
+                block_root=chain.types.BeaconBlock[fork].hash_tree_root(
+                    signed_block.message
+                ),
+                pre_state=pre,
+            )
+        )
+    return out

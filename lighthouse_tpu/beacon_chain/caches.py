@@ -1,0 +1,289 @@
+"""The beacon-chain cache fleet.
+
+Mirrors the reference's per-concern caches (SURVEY.md §2.3 "cache fleet"):
+validator_pubkey_cache.rs (decompress each pubkey once, persist),
+shuffling_cache.rs (committee shufflings keyed by (epoch, decision_root)),
+snapshot_cache.rs (recent post-states for cheap parent lookups),
+beacon_proposer_cache.rs, observed_attesters.rs / observed_aggregates.rs /
+observed_block_producers.rs (gossip equivocation tracking).
+
+All bounded; all guarded by plain locks with no cross-cache lock nesting
+(the reference's deadlock discipline, SURVEY.md §5.2).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from lighthouse_tpu.crypto.bls.api import PublicKey
+from lighthouse_tpu.state_transition import helpers as h
+from lighthouse_tpu.store.kv import DBColumn
+
+
+class CacheError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Validator pubkey cache
+# ---------------------------------------------------------------------------
+
+
+class ValidatorPubkeyCache:
+    """validator_index -> decompressed PublicKey.
+
+    Pubkey decompression (48-byte compressed -> affine point with subgroup
+    check) is expensive; the registry is append-only, so each key is
+    decompressed exactly once and persisted (validator_pubkey_cache.rs:10-23).
+    """
+
+    def __init__(self, store=None):
+        self._keys: List[PublicKey] = []
+        self._index_by_bytes: Dict[bytes, int] = {}
+        self._lock = threading.Lock()
+        self._store = store
+        if store is not None:
+            self._load()
+
+    def _load(self) -> None:
+        for key_bytes, idx_raw in self._store.hot.iter_column_from(
+            DBColumn.PubkeyCache
+        ):
+            idx = int.from_bytes(idx_raw, "little")
+            pk = PublicKey.from_bytes(bytes(key_bytes))
+            while len(self._keys) <= idx:
+                self._keys.append(None)
+            self._keys[idx] = pk
+            self._index_by_bytes[bytes(key_bytes)] = idx
+
+    def import_new_pubkeys(self, state) -> None:
+        """Decompress + persist any validators beyond the cache frontier."""
+        with self._lock:
+            start = len(self._keys)
+            n = len(state.validators)
+            if n <= start:
+                return
+            ops = []
+            for i in range(start, n):
+                pk_bytes = bytes(state.validators[i].pubkey)
+                pk = PublicKey.from_bytes(pk_bytes)  # decompress + validate
+                self._keys.append(pk)
+                self._index_by_bytes[pk_bytes] = i
+                ops.append(("put", DBColumn.PubkeyCache, pk_bytes,
+                            i.to_bytes(8, "little")))
+            if self._store is not None and ops:
+                self._store.hot.do_atomically(ops)
+
+    def get(self, index: int) -> Optional[PublicKey]:
+        with self._lock:
+            if 0 <= index < len(self._keys):
+                return self._keys[index]
+            return None
+
+    def get_index(self, pubkey_bytes: bytes) -> Optional[int]:
+        with self._lock:
+            return self._index_by_bytes.get(bytes(pubkey_bytes))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._keys)
+
+
+# ---------------------------------------------------------------------------
+# Committee shuffling cache
+# ---------------------------------------------------------------------------
+
+
+# All committees of one epoch, computed once from a state (the compute side
+# of beacon_state's committee caches) — the shuffle engine lives in helpers.
+CommitteeCache = h.CommitteeCache
+
+
+def shuffling_decision_root(state, spec, epoch: int) -> bytes:
+    """The block root that seals epoch `epoch`'s shuffling: the last block of
+    `epoch - 2`'s end (attestation_verification's shuffling_id semantics).
+    Falls back to genesis-ish zero when the history isn't there yet."""
+    decision_slot = spec.start_slot_of_epoch(max(epoch - 1, 0))
+    if decision_slot == 0 or decision_slot > state.slot:
+        return b"\x00" * 32
+    return h.get_block_root_at_slot(state, spec, decision_slot - 1)
+
+
+class ShufflingCache:
+    """(epoch, decision_root) -> CommitteeCache, LRU-bounded
+    (shuffling_cache.rs:60; 16 entries there, same here)."""
+
+    MAX = 16
+
+    def __init__(self):
+        self._map: "OrderedDict[Tuple[int, bytes], CommitteeCache]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def get_or_compute(self, state, spec, epoch: int) -> CommitteeCache:
+        key = (epoch, shuffling_decision_root(state, spec, epoch))
+        with self._lock:
+            if key in self._map:
+                self._map.move_to_end(key)
+                return self._map[key]
+        cache = CommitteeCache(state, spec, epoch)  # compute outside the lock
+        with self._lock:
+            self._map[key] = cache
+            self._map.move_to_end(key)
+            while len(self._map) > self.MAX:
+                self._map.popitem(last=False)
+        return cache
+
+
+# ---------------------------------------------------------------------------
+# Snapshot (recent post-state) cache
+# ---------------------------------------------------------------------------
+
+
+class SnapshotCache:
+    """block_root -> (post_state, signed_block). Keeps the most recent N
+    imports so child blocks find their pre-state without a store read
+    (snapshot_cache.rs:154; 4 snapshots there, default 4 here)."""
+
+    def __init__(self, max_snapshots: int = 4):
+        self.max = max_snapshots
+        self._map: "OrderedDict[bytes, tuple]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def insert(self, block_root: bytes, state, signed_block=None) -> None:
+        with self._lock:
+            self._map[block_root] = (state, signed_block)
+            self._map.move_to_end(block_root)
+            while len(self._map) > self.max:
+                self._map.popitem(last=False)
+
+    def get_state_clone(self, block_root: bytes):
+        with self._lock:
+            hit = self._map.get(block_root)
+        if hit is None:
+            return None
+        return hit[0].copy()
+
+    def contains(self, block_root: bytes) -> bool:
+        with self._lock:
+            return block_root in self._map
+
+
+# ---------------------------------------------------------------------------
+# Proposer cache
+# ---------------------------------------------------------------------------
+
+
+class ProposerCache:
+    """(epoch, decision_root) -> proposer index per slot of the epoch
+    (beacon_proposer_cache.rs)."""
+
+    MAX = 16
+
+    def __init__(self):
+        self._map: "OrderedDict[Tuple[int, bytes], List[int]]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def get_or_compute(self, state, spec, epoch: int) -> List[int]:
+        key = (epoch, shuffling_decision_root(state, spec, epoch))
+        with self._lock:
+            if key in self._map:
+                self._map.move_to_end(key)
+                return self._map[key]
+        start = spec.start_slot_of_epoch(epoch)
+        proposers = [
+            h.get_beacon_proposer_index(state, spec, slot=start + i)
+            for i in range(spec.preset.SLOTS_PER_EPOCH)
+        ]
+        with self._lock:
+            self._map[key] = proposers
+            while len(self._map) > self.MAX:
+                self._map.popitem(last=False)
+        return proposers
+
+
+# ---------------------------------------------------------------------------
+# Observation caches (gossip equivocation defence)
+# ---------------------------------------------------------------------------
+
+
+class ObservedAttesters:
+    """Per-(epoch|slot) seen-validator sets: "has validator V already
+    attested in epoch E / produced an aggregate for slot S?"
+    (observed_attesters.rs:85-599 — bitfield per epoch there; sets here,
+    pruned below the finalized/valid window)."""
+
+    def __init__(self, retain: int = 2):
+        self.retain = retain
+        self._map: Dict[int, Set[int]] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, period: int, validator_index: int) -> bool:
+        """Record; returns True if it was already present."""
+        with self._lock:
+            seen = self._map.setdefault(period, set())
+            if validator_index in seen:
+                return True
+            seen.add(validator_index)
+            return False
+
+    def is_known(self, period: int, validator_index: int) -> bool:
+        with self._lock:
+            return validator_index in self._map.get(period, set())
+
+    def prune(self, current_period: int) -> None:
+        with self._lock:
+            low = current_period - self.retain
+            for p in [p for p in self._map if p < low]:
+                del self._map[p]
+
+
+class ObservedItems:
+    """Seen-object roots per slot (observed_aggregates.rs:269 /
+    observed_blob_sidecars.rs shape)."""
+
+    def __init__(self, retain_slots: int = 64):
+        self.retain = retain_slots
+        self._map: Dict[int, Set[bytes]] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, slot: int, item_root: bytes) -> bool:
+        with self._lock:
+            seen = self._map.setdefault(slot, set())
+            if item_root in seen:
+                return True
+            seen.add(item_root)
+            return False
+
+    def prune(self, current_slot: int) -> None:
+        with self._lock:
+            low = current_slot - self.retain
+            for s in [s for s in self._map if s < low]:
+                del self._map[s]
+
+
+class ObservedBlockProducers:
+    """(slot, proposer) -> block root seen on gossip. A DIFFERENT block from
+    the same proposer at the same slot is an equivocation; re-seeing the
+    same root is a harmless duplicate (observed_block_producers.rs
+    SeenBlock::{Duplicate,Slashable} distinction)."""
+
+    def __init__(self):
+        self._map: Dict[int, Dict[int, bytes]] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, slot: int, proposer_index: int, block_root: bytes) -> bool:
+        """Record; returns True only on a CONFLICTING (equivocating) block."""
+        with self._lock:
+            seen = self._map.setdefault(slot, {})
+            prev = seen.get(proposer_index)
+            if prev is None:
+                seen[proposer_index] = bytes(block_root)
+                return False
+            return prev != bytes(block_root)
+
+    def prune(self, finalized_slot: int) -> None:
+        with self._lock:
+            for s in [s for s in self._map if s < finalized_slot]:
+                del self._map[s]

@@ -1,0 +1,467 @@
+"""BeaconChain — the core runtime tying store, fork choice, caches and the
+BLS backend together.
+
+Mirror of beacon_node/beacon_chain/src/beacon_chain.rs (SURVEY.md §1 L4):
+`process_block` (:2982) drives the verification typestate and imports;
+`process_attestation` feeds fork choice (apply_attestation_to_fork_choice
+:2122); `produce_unaggregated_attestation` (:1742); `recompute_head`
+(canonical_head.rs:477). The canonical head is a cached snapshot — readers
+never replay states.
+
+Lock discipline: one chain-wide RLock for imports + head updates (the
+reference splits this into the canonical_head lock protocol; a single
+coarse lock is correct, contention moves to the beacon_processor layer).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import List, Optional
+
+from lighthouse_tpu.common.slot_clock import ManualSlotClock, SlotClock
+from lighthouse_tpu.fork_choice.fork_choice import CheckpointSnapshot, ForkChoice
+from lighthouse_tpu.fork_choice.proto_array import ExecutionStatus
+from lighthouse_tpu.state_transition import helpers as h
+from lighthouse_tpu.state_transition import slot_processing as sp
+from lighthouse_tpu.store.hot_cold import HotColdDB
+
+from . import attestation_verification as att_ver
+from . import block_verification as blk_ver
+from .block_verification import BlockError
+from .caches import (
+    ObservedAttesters,
+    ObservedBlockProducers,
+    ObservedItems,
+    ProposerCache,
+    ShufflingCache,
+    SnapshotCache,
+    ValidatorPubkeyCache,
+)
+
+
+@dataclass
+class CanonicalHead:
+    block_root: bytes
+    block: object
+    state: object
+    state_root: bytes
+
+
+class BeaconChain:
+    def __init__(
+        self,
+        types,
+        spec,
+        genesis_state,
+        store: Optional[HotColdDB] = None,
+        bls_backend: Optional[str] = None,
+        slot_clock: Optional[SlotClock] = None,
+        execution_layer=None,
+        op_pool=None,
+    ):
+        self.types = types
+        self.spec = spec
+        self.store = store if store is not None else HotColdDB(types, spec)
+        self.bls_backend = bls_backend
+        self.execution_layer = execution_layer
+        self.op_pool = op_pool
+        self._lock = threading.RLock()
+
+        fork = spec.fork_name_at_epoch(spec.epoch_at_slot(genesis_state.slot))
+        state_cls = types.BeaconState[fork]
+        genesis_state_root = state_cls.hash_tree_root(genesis_state)
+
+        # The genesis "block": the state's own header with its root patched
+        # (what the reference persists as the anchor block).
+        header = genesis_state.latest_block_header.copy()
+        if bytes(header.state_root) == b"\x00" * 32:
+            header.state_root = genesis_state_root
+        genesis_block_root = types.BeaconBlockHeader.hash_tree_root(header)
+
+        self.genesis_block_root = genesis_block_root
+        self.store.put_state(genesis_state_root, genesis_state)
+        self.store.put_genesis_block_root(genesis_block_root)
+
+        cp = CheckpointSnapshot(
+            epoch=spec.epoch_at_slot(genesis_state.slot), root=genesis_block_root
+        )
+        self.fork_choice = ForkChoice(
+            spec,
+            anchor_root=genesis_block_root,
+            anchor_slot=genesis_state.slot,
+            justified=cp,
+            finalized=cp,
+        )
+        self.fork_choice._refresh_justified_balances(genesis_state, spec)
+
+        self.slot_clock = slot_clock or ManualSlotClock(
+            genesis_state.genesis_time, spec.seconds_per_slot
+        )
+
+        # Cache fleet.
+        self.pubkey_cache = ValidatorPubkeyCache(store=self.store)
+        self.pubkey_cache.import_new_pubkeys(genesis_state)
+        self.shuffling_cache = ShufflingCache()
+        self.snapshot_cache = SnapshotCache()
+        self.proposer_cache = ProposerCache()
+        self.observed_attesters = ObservedAttesters()
+        self.observed_aggregators = ObservedAttesters()
+        self.observed_aggregates = ObservedItems()
+        self.observed_block_producers = ObservedBlockProducers()
+
+        self.head = CanonicalHead(
+            block_root=genesis_block_root,
+            block=None,
+            state=genesis_state,
+            state_root=genesis_state_root,
+        )
+        self.snapshot_cache.insert(genesis_block_root, genesis_state)
+        # Map block_root -> state_root for states we've imported (the hot
+        # summaries carry this implicitly; this avoids a store read on the
+        # import path).
+        self._state_root_by_block = {genesis_block_root: genesis_state_root}
+
+    # ------------------------------------------------------------------ time
+
+    def current_slot(self) -> int:
+        return self.slot_clock.now_or_genesis()
+
+    def fork_at(self, slot: int) -> str:
+        return self.spec.fork_name_at_epoch(self.spec.epoch_at_slot(slot))
+
+    # ------------------------------------------------------------- accessors
+
+    def block_is_known(self, block_root: bytes) -> bool:
+        return self.fork_choice.proto.contains_block(block_root) or \
+            self.store.block_exists(block_root)
+
+    def head_state_for_signatures(self):
+        """Fork/domain/pubkey context for signature sets — read-only use."""
+        return self.head.state
+
+    def head_state_clone_at(self, slot: int):
+        """Clone of the head state advanced to (at least) `slot`'s epoch
+        start — shuffling/proposer decisions."""
+        state = self.head.state
+        target_epoch = self.spec.epoch_at_slot(slot)
+        if h.get_current_epoch(state, self.spec) >= target_epoch:
+            return state
+        clone = state.copy()
+        sp.process_slots(
+            clone, self.types, self.spec,
+            self.spec.start_slot_of_epoch(target_epoch),
+            fork=self.fork_at(slot),
+        )
+        return clone
+
+    def committees_at(self, slot: int):
+        epoch = self.spec.epoch_at_slot(slot)
+        state = self.head_state_clone_at(slot)
+        return self.shuffling_cache.get_or_compute(state, self.spec, epoch)
+
+    def pubkey_getter(self, validator_index: int):
+        return self.pubkey_cache.get(validator_index)
+
+    def state_for_block_import(self, parent_block_root: bytes):
+        """Pre-state for a child of `parent_block_root` (clone). Snapshot
+        cache first, store summary replay second."""
+        state = self.snapshot_cache.get_state_clone(parent_block_root)
+        if state is not None:
+            return state
+        state_root = self._state_root_by_block.get(parent_block_root)
+        if state_root is None:
+            parent = self.store.get_block(parent_block_root)
+            if parent is None:
+                return None
+            state_root = bytes(parent.message.state_root)
+        return self.store.get_state(state_root)
+
+    # -------------------------------------------------------------- imports
+
+    def process_block(self, signed_block) -> bytes:
+        """Full import pipeline; returns the block root
+        (beacon_chain.rs:2982 process_block)."""
+        with self._lock:
+            gossip = blk_ver.gossip_verify_block(self, signed_block)
+            sig = blk_ver.signature_verify_block(self, gossip)
+            pending = blk_ver.into_execution_pending_block(self, sig)
+            return self.import_block(pending)
+
+    def process_block_from_segment(self, sig_verified) -> bytes:
+        """Import one signature-verified block of a range segment."""
+        with self._lock:
+            pending = blk_ver.into_execution_pending_block(self, sig_verified)
+            return self.import_block(pending)
+
+    def import_block(self, pending) -> bytes:
+        """fork choice + store + head update (import_available_block :3023)."""
+        with self._lock:
+            block = pending.signed_block.message
+            root = pending.block_root
+            state = pending.post_state
+            current = self.current_slot()
+            prev_finalized = self.fork_choice.finalized.epoch
+
+            exec_status = {
+                "valid": ExecutionStatus.VALID,
+                "optimistic": ExecutionStatus.OPTIMISTIC,
+                "irrelevant": ExecutionStatus.IRRELEVANT,
+            }[pending.payload_status]
+            exec_hash = None
+            if hasattr(block.body, "execution_payload"):
+                exec_hash = bytes(block.body.execution_payload.block_hash)
+            self.fork_choice.on_block(
+                current, block, root, state, self.types, self.spec,
+                execution_status=exec_status, execution_block_hash=exec_hash,
+            )
+            # LMD votes carried by the block (apply att to fork choice).
+            self._apply_block_attestations_to_fork_choice(block, state, current)
+
+            # Timely current-slot block gets the proposer boost.
+            if block.slot == current and \
+                    self.slot_clock.seconds_into_slot() * 3 < self.spec.seconds_per_slot:
+                self.fork_choice.on_proposer_boost(root, block.slot)
+
+            state_root = bytes(block.state_root)
+            ops = self.store.block_put_ops(root, pending.signed_block)
+            ops += self.store.state_put_ops(state_root, state)
+            self.store.hot.do_atomically(ops)
+            self._state_root_by_block[root] = state_root
+            self.snapshot_cache.insert(root, state, pending.signed_block)
+            self.pubkey_cache.import_new_pubkeys(state)
+
+            self.recompute_head()
+            if self.fork_choice.finalized.epoch > prev_finalized:
+                self._on_finalization()
+            return root
+
+    def _apply_block_attestations_to_fork_choice(self, block, state, current_slot):
+        for att in block.body.attestations:
+            try:
+                committees = self.shuffling_cache.get_or_compute(
+                    state, self.spec, att.data.target.epoch
+                )
+                committee = committees.committee(att.data.slot, att.data.index)
+                indices = [
+                    v for v, b in zip(committee, att.aggregation_bits) if b
+                ]
+                self.fork_choice.on_attestation(
+                    current_slot, indices, bytes(att.data.beacon_block_root),
+                    att.data.target.epoch, att.data.slot, is_from_block=True,
+                )
+            except Exception:
+                # Votes from blocks are best-effort (the block itself already
+                # validated them against its own state).
+                pass
+
+    def _on_finalization(self):
+        """Prune fork choice + observation caches; freezer migration
+        (migrate.rs BackgroundMigrator responsibility, run inline)."""
+        self.fork_choice.prune()
+        fin_epoch = self.fork_choice.finalized.epoch
+        self.observed_attesters.prune(fin_epoch)
+        self.observed_aggregators.prune(fin_epoch)
+        fin_slot = self.spec.start_slot_of_epoch(fin_epoch)
+        self.observed_aggregates.prune(fin_slot)
+        self.observed_block_producers.prune(fin_slot)
+        fin_root = self.fork_choice.finalized.root
+        state_root = self._state_root_by_block.get(fin_root)
+        if state_root is None:
+            return
+        fin_state = self.store.get_state(state_root)
+        if fin_state is not None:
+            try:
+                self.store.migrate_to_freezer(fin_state, state_root)
+            except Exception:
+                pass  # window exceeded (deep finality jump): next round
+
+    # ---------------------------------------------------------- attestations
+
+    def process_attestation(self, attestation, subnet_id: Optional[int] = None):
+        """Gossip unaggregated path: verify + fork choice
+        (§3.2 of SURVEY.md)."""
+        verified = att_ver.verify_unaggregated_attestation(
+            self, attestation, subnet_id
+        )
+        self.apply_attestation_to_fork_choice(verified.indexed_attestation)
+        if self.op_pool is not None:
+            self.op_pool.insert_attestation(attestation, verified.indexed_attestation)
+        return verified
+
+    def process_attestation_batch(self, attestations):
+        results = att_ver.batch_verify_unaggregated_attestations(
+            self, [(a, None) for a in attestations]
+        )
+        for r in results:
+            if isinstance(r, att_ver.VerifiedUnaggregatedAttestation):
+                self.apply_attestation_to_fork_choice(r.indexed_attestation)
+                if self.op_pool is not None:
+                    self.op_pool.insert_attestation(
+                        r.attestation, r.indexed_attestation
+                    )
+        return results
+
+    def process_aggregate(self, signed_aggregate):
+        verified = att_ver.verify_aggregated_attestation(self, signed_aggregate)
+        self.apply_attestation_to_fork_choice(verified.indexed_attestation)
+        if self.op_pool is not None:
+            self.op_pool.insert_attestation(
+                verified.signed_aggregate.message.aggregate,
+                verified.indexed_attestation,
+            )
+        return verified
+
+    def apply_attestation_to_fork_choice(self, indexed_att) -> None:
+        data = indexed_att.data
+        self.fork_choice.on_attestation(
+            self.current_slot(),
+            list(indexed_att.attesting_indices),
+            bytes(data.beacon_block_root),
+            data.target.epoch,
+            data.slot,
+        )
+
+    def produce_unaggregated_attestation(self, slot: int, committee_index: int):
+        """AttestationData for (slot, index) at the current head
+        (beacon_chain.rs:1742)."""
+        state = self.head_state_clone_at(slot)
+        t, spec = self.types, self.spec
+        epoch = spec.epoch_at_slot(slot)
+        if slot < state.slot:
+            head_root = h.get_block_root_at_slot(state, spec, slot)
+        else:
+            head_root = self.head.block_root
+        target_start = spec.start_slot_of_epoch(epoch)
+        if target_start < state.slot:
+            target_root = h.get_block_root_at_slot(state, spec, target_start)
+        else:
+            target_root = self.head.block_root
+        return t.AttestationData(
+            slot=slot,
+            index=committee_index,
+            beacon_block_root=head_root,
+            source=state.current_justified_checkpoint,
+            target=t.Checkpoint(epoch=epoch, root=target_root),
+        )
+
+    # ------------------------------------------------------------ production
+
+    def produce_block(
+        self,
+        slot: int,
+        randao_reveal: bytes,
+        graffiti: bytes = b"\x00" * 32,
+    ):
+        """Assemble an unsigned block on the current head: pool attestations
+        via max-cover, slashings/exits, execution payload from the EL (or an
+        empty self-built one) (produce_block_with_verification :4092).
+        Returns (block, post_state); the caller signs."""
+        from lighthouse_tpu.crypto.bls import api as bls
+        from lighthouse_tpu.state_transition import block_processing as bp
+
+        with self._lock:
+            t, spec = self.types, self.spec
+            fork = self.fork_at(slot)
+            parent_root = self.head.block_root
+            state = self.state_for_block_import(parent_root)
+            sp.process_slots(state, t, spec, slot, fork=fork)
+            epoch = spec.epoch_at_slot(slot)
+
+            attestations = []
+            proposer_slashings: list = []
+            attester_slashings: list = []
+            exits: list = []
+            bls_changes: list = []
+            if self.op_pool is not None:
+                committees_fn = lambda s, i: self.committees_at(s).committee(s, i)
+                attestations = self.op_pool.get_attestations(state, committees_fn)
+                proposer_slashings, attester_slashings, exits = \
+                    self.op_pool.get_slashings_and_exits(state)
+                bls_changes = self.op_pool.get_bls_to_execution_changes(state)
+
+            if self.execution_layer is not None:
+                payload = self.execution_layer.get_payload(
+                    parent_hash=bytes(
+                        state.latest_execution_payload_header.block_hash
+                    ),
+                    timestamp=state.genesis_time + slot * spec.seconds_per_slot,
+                    prev_randao=h.get_randao_mix(state, spec, epoch),
+                    withdrawals=bp.get_expected_withdrawals(state, t, spec),
+                )
+            else:
+                import hashlib as _hl
+
+                payload = t.ExecutionPayloadCapella(
+                    parent_hash=state.latest_execution_payload_header.block_hash,
+                    prev_randao=h.get_randao_mix(state, spec, epoch),
+                    block_number=(
+                        state.latest_execution_payload_header.block_number + 1
+                    ),
+                    timestamp=state.genesis_time + slot * spec.seconds_per_slot,
+                    block_hash=_hl.sha256(
+                        bytes(state.latest_execution_payload_header.block_hash)
+                        + slot.to_bytes(8, "little")
+                    ).digest(),
+                    withdrawals=bp.get_expected_withdrawals(state, t, spec),
+                )
+
+            proposer = h.get_beacon_proposer_index(state, spec)
+            body = t.BeaconBlockBodyCapella(
+                randao_reveal=randao_reveal,
+                eth1_data=state.eth1_data,
+                graffiti=graffiti,
+                proposer_slashings=proposer_slashings,
+                attester_slashings=attester_slashings,
+                attestations=attestations,
+                voluntary_exits=exits,
+                sync_aggregate=t.SyncAggregate(
+                    sync_committee_bits=[False] * spec.preset.SYNC_COMMITTEE_SIZE,
+                    sync_committee_signature=bls.Signature.infinity().to_bytes(),
+                ),
+                execution_payload=payload,
+                bls_to_execution_changes=bls_changes,
+            )
+            block = t.BeaconBlock[fork](
+                slot=slot,
+                proposer_index=proposer,
+                parent_root=parent_root,
+                state_root=b"\x00" * 32,
+                body=body,
+            )
+            post = state
+            unsigned = t.SignedBeaconBlock[fork](
+                message=block, signature=b"\x00" * 96
+            )
+            bp.per_block_processing(
+                post, t, spec, unsigned, fork,
+                verify_signatures=bp.VerifySignatures.FALSE,
+            )
+            block.state_root = t.BeaconState[fork].hash_tree_root(post)
+            return block, post
+
+    # ----------------------------------------------------------------- head
+
+    def recompute_head(self) -> bytes:
+        """fork choice get_head -> refresh the cached snapshot
+        (canonical_head.rs:477)."""
+        with self._lock:
+            head_root = self.fork_choice.get_head(self.current_slot())
+            if head_root == self.head.block_root:
+                return head_root
+            state = None
+            state_root = self._state_root_by_block.get(head_root)
+            hit = self.snapshot_cache.get_state_clone(head_root)
+            if hit is not None:
+                state = hit
+            elif state_root is not None:
+                state = self.store.get_state(state_root)
+            if state is None:
+                return self.head.block_root  # cannot switch without a state
+            self.head = CanonicalHead(
+                block_root=head_root,
+                block=self.store.get_block(head_root),
+                state=state,
+                state_root=state_root or b"",
+            )
+            return head_root

@@ -1,0 +1,1 @@
+"""Shared runtime utilities (the reference's common/ crates, SURVEY.md §2.6)."""

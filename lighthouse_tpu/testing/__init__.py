@@ -1,0 +1,2 @@
+"""In-process test rigs (reference: beacon_chain/src/test_utils.rs harness,
+testing/node_test_rig, testing/simulator — SURVEY.md §4.3)."""

@@ -1,0 +1,274 @@
+"""BeaconChainHarness — deterministic in-process chain driver.
+
+Mirror of beacon_chain/src/test_utils.rs:604: interop keypairs, manual slot
+clock, memory store; can extend the canonical chain (or any fork) with
+fully-signed blocks, produce signed attestations/aggregates for every
+committee, and hand them to the chain's verification pipelines.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional, Sequence, Tuple
+
+from lighthouse_tpu.beacon_chain import BeaconChain
+from lighthouse_tpu.crypto.bls import api as bls
+from lighthouse_tpu.state_transition import block_processing as bp
+from lighthouse_tpu.state_transition import genesis as gen
+from lighthouse_tpu.state_transition import helpers as h
+from lighthouse_tpu.state_transition import slot_processing as sp
+from lighthouse_tpu.types import ssz
+from lighthouse_tpu.types.containers import make_types
+from lighthouse_tpu.types.spec import (
+    DOMAIN_AGGREGATE_AND_PROOF,
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_BEACON_PROPOSER,
+    DOMAIN_RANDAO,
+    DOMAIN_SELECTION_PROOF,
+    compute_signing_root,
+    get_domain,
+    minimal_spec,
+)
+
+
+class BeaconChainHarness:
+    def __init__(
+        self,
+        n_validators: int = 64,
+        spec=None,
+        bls_backend: Optional[str] = None,
+        genesis_time: int = 1_600_000_000,
+        store=None,
+        execution_layer=None,
+        op_pool=None,
+    ):
+        self.spec = spec or minimal_spec()
+        self.types = make_types(self.spec.preset)
+        self.keys = gen.generate_deterministic_keypairs(n_validators)
+        genesis_state = gen.interop_genesis_state(
+            self.types, self.spec, self.keys, genesis_time=genesis_time
+        )
+        self.chain = BeaconChain(
+            self.types,
+            self.spec,
+            genesis_state,
+            store=store,
+            bls_backend=bls_backend,
+            execution_layer=execution_layer,
+            op_pool=op_pool,
+        )
+
+    # ------------------------------------------------------------------ time
+
+    def set_slot(self, slot: int) -> None:
+        self.chain.slot_clock.set_slot(slot)
+
+    def advance_slot(self, n: int = 1) -> None:
+        self.chain.slot_clock.advance_slot(n)
+
+    @property
+    def current_slot(self) -> int:
+        return self.chain.current_slot()
+
+    # -------------------------------------------------------------- signing
+
+    def _domain(self, state, domain_type: bytes, epoch: int) -> bytes:
+        return get_domain(
+            self.spec, domain_type, epoch,
+            state.fork.current_version, state.fork.previous_version,
+            state.fork.epoch, state.genesis_validators_root,
+        )
+
+    def sign_block(self, state, block, fork: str):
+        domain = self._domain(
+            state, DOMAIN_BEACON_PROPOSER, self.spec.epoch_at_slot(block.slot)
+        )
+        root = compute_signing_root(block, self.types.BeaconBlock[fork], domain)
+        sig = self.keys[block.proposer_index].sign(root)
+        return self.types.SignedBeaconBlock[fork](
+            message=block, signature=sig.to_bytes()
+        )
+
+    def randao_reveal(self, state, epoch: int, proposer_index: int) -> bytes:
+        domain = self._domain(state, DOMAIN_RANDAO, epoch)
+        root = compute_signing_root(epoch, ssz.uint64, domain)
+        return self.keys[proposer_index].sign(root).to_bytes()
+
+    # ------------------------------------------------------------ production
+
+    def make_block(
+        self,
+        parent_root: Optional[bytes] = None,
+        slot: Optional[int] = None,
+        attestations: Sequence = (),
+    ):
+        """Fully-signed valid block on `parent_root` (default: head) at
+        `slot` (default: current). Returns (signed_block, block_root)."""
+        chain = self.chain
+        types, spec = self.types, self.spec
+        parent_root = parent_root or chain.head.block_root
+        slot = slot if slot is not None else self.current_slot
+        fork = chain.fork_at(slot)
+
+        state = chain.state_for_block_import(parent_root)
+        if state is None:
+            raise ValueError("unknown parent")
+        sp.process_slots(state, types, spec, slot, fork=fork)
+        proposer = h.get_beacon_proposer_index(state, spec)
+        epoch = spec.epoch_at_slot(slot)
+
+        payload = types.ExecutionPayloadCapella(
+            parent_hash=state.latest_execution_payload_header.block_hash,
+            prev_randao=h.get_randao_mix(state, spec, epoch),
+            block_number=state.latest_execution_payload_header.block_number + 1,
+            timestamp=state.genesis_time + slot * spec.seconds_per_slot,
+            block_hash=hashlib.sha256(
+                bytes(state.latest_execution_payload_header.block_hash)
+                + slot.to_bytes(8, "little")
+            ).digest(),
+            withdrawals=bp.get_expected_withdrawals(state, types, spec),
+        )
+        body = types.BeaconBlockBodyCapella(
+            randao_reveal=self.randao_reveal(state, epoch, proposer),
+            eth1_data=state.eth1_data,
+            graffiti=b"\x00" * 32,
+            attestations=list(attestations),
+            sync_aggregate=types.SyncAggregate(
+                sync_committee_bits=[False] * spec.preset.SYNC_COMMITTEE_SIZE,
+                sync_committee_signature=bls.Signature.infinity().to_bytes(),
+            ),
+            execution_payload=payload,
+        )
+        block = types.BeaconBlock[fork](
+            slot=slot,
+            proposer_index=proposer,
+            parent_root=parent_root,
+            state_root=b"\x00" * 32,
+            body=body,
+        )
+        # Fill state_root by running the transition.
+        post = state.copy()
+        unsigned = types.SignedBeaconBlock[fork](message=block, signature=b"\x00" * 96)
+        bp.per_block_processing(
+            post, types, spec, unsigned, fork,
+            verify_signatures=bp.VerifySignatures.FALSE,
+        )
+        block.state_root = types.BeaconState[fork].hash_tree_root(post)
+        signed = self.sign_block(state, block, fork)
+        root = types.BeaconBlock[fork].hash_tree_root(block)
+        return signed, root
+
+    def make_attestations(
+        self, slot: Optional[int] = None, head_root: Optional[bytes] = None
+    ) -> List:
+        """One fully-signed attestation per committee of `slot`, voting for
+        the current head chain."""
+        chain = self.chain
+        types, spec = self.types, self.spec
+        slot = slot if slot is not None else self.current_slot
+        state = chain.head_state_clone_at(slot)
+        epoch = spec.epoch_at_slot(slot)
+        committees = chain.committees_at(slot)
+
+        if head_root is None:
+            if slot < state.slot:
+                head_root = h.get_block_root_at_slot(state, spec, slot)
+            else:
+                head_root = chain.head.block_root
+        target_start = spec.start_slot_of_epoch(epoch)
+        if target_start < state.slot:
+            target_root = h.get_block_root_at_slot(state, spec, target_start)
+        elif target_start == slot:
+            target_root = head_root
+        else:
+            target_root = chain.head.block_root
+
+        out = []
+        for index in range(committees.committees_per_slot):
+            committee = committees.committee(slot, index)
+            data = types.AttestationData(
+                slot=slot,
+                index=index,
+                beacon_block_root=head_root,
+                source=state.current_justified_checkpoint,
+                target=types.Checkpoint(epoch=epoch, root=target_root),
+            )
+            domain = self._domain(state, DOMAIN_BEACON_ATTESTER, epoch)
+            root = compute_signing_root(data, types.AttestationData, domain)
+            sigs = [self.keys[v].sign(root) for v in committee]
+            agg = bls.AggregateSignature.aggregate(sigs)
+            out.append(
+                types.Attestation(
+                    aggregation_bits=[True] * len(committee),
+                    data=data,
+                    signature=bls.Signature(
+                        point=agg.point, subgroup_checked=True
+                    ).to_bytes(),
+                )
+            )
+        return out
+
+    def single_attestation(self, attestation, member_pos: int, committee: List[int]):
+        """Unaggregated variant: exactly one bit set, signed by that member."""
+        types = self.types
+        state = self.chain.head_state_for_signatures()
+        data = attestation.data
+        domain = self._domain(state, DOMAIN_BEACON_ATTESTER, data.target.epoch)
+        root = compute_signing_root(data, types.AttestationData, domain)
+        bits = [False] * len(committee)
+        bits[member_pos] = True
+        sig = self.keys[committee[member_pos]].sign(root)
+        return types.Attestation(
+            aggregation_bits=bits, data=data, signature=sig.to_bytes()
+        )
+
+    def make_aggregate(self, attestation, committee: List[int]):
+        """SignedAggregateAndProof from the first selected aggregator in the
+        committee (minimal spec: everyone selects)."""
+        types, spec = self.types, self.spec
+        state = self.chain.head_state_for_signatures()
+        slot = attestation.data.slot
+        sel_domain = self._domain(
+            state, DOMAIN_SELECTION_PROOF, spec.epoch_at_slot(slot)
+        )
+        sel_root = compute_signing_root(slot, ssz.uint64, sel_domain)
+        target = spec.preset.TARGET_AGGREGATORS_PER_COMMITTEE
+        modulo = max(1, len(committee) // target)
+        for aggregator in committee:
+            proof = self.keys[aggregator].sign(sel_root).to_bytes()
+            digest = hashlib.sha256(proof).digest()
+            if int.from_bytes(digest[:8], "little") % modulo == 0:
+                break
+        else:
+            raise RuntimeError("no aggregator selected in committee")
+        msg = types.AggregateAndProof(
+            aggregator_index=aggregator,
+            aggregate=attestation,
+            selection_proof=proof,
+        )
+        agg_domain = self._domain(
+            state, DOMAIN_AGGREGATE_AND_PROOF, spec.epoch_at_slot(slot)
+        )
+        agg_root = compute_signing_root(msg, types.AggregateAndProof, agg_domain)
+        outer = self.keys[aggregator].sign(agg_root).to_bytes()
+        return types.SignedAggregateAndProof(message=msg, signature=outer)
+
+    # ------------------------------------------------------------- extension
+
+    def extend_chain(
+        self, n_blocks: int, attest: bool = True
+    ) -> List[Tuple[bytes, object]]:
+        """Produce+import n blocks on the canonical head, advancing the clock
+        slot by slot; each block carries the previous slot's attestations
+        when `attest` (extend_chain in test_utils.rs)."""
+        out = []
+        for _ in range(n_blocks):
+            self.advance_slot()
+            slot = self.current_slot
+            atts = []
+            if attest and slot >= 2:
+                atts = self.make_attestations(slot - 1)
+            signed, root = self.make_block(slot=slot, attestations=atts)
+            self.chain.process_block(signed)
+            out.append((root, signed))
+        return out
