@@ -1,0 +1,21 @@
+"""Work scheduler layer (reference: beacon_node/beacon_processor, L7)."""
+
+from .processor import (
+    BATCHABLE,
+    DEFAULT_MAX_BATCH,
+    PRIORITY,
+    QUEUE_CAPS,
+    BeaconProcessor,
+    WorkEvent,
+)
+from .reprocess import ReprocessQueue
+
+__all__ = [
+    "BATCHABLE",
+    "BeaconProcessor",
+    "DEFAULT_MAX_BATCH",
+    "PRIORITY",
+    "QUEUE_CAPS",
+    "ReprocessQueue",
+    "WorkEvent",
+]

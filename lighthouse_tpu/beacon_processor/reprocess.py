@@ -1,0 +1,89 @@
+"""Work reprocessing queue — delayed re-delivery.
+
+Mirror of beacon_processor/src/work_reprocessing_queue.rs: early blocks held
+until their slot starts (+ a small pad, :40), attestations referencing an
+unknown block parked until that block imports or a timeout passes (12 s,
+:43), backfill work paced into quiet slot fractions (:59). Implemented as a
+monotonic-deadline heap + an unknown-block index, polled by the processor's
+manager loop.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+EARLY_BLOCK_PAD_SECONDS = 0.005          # :40
+UNKNOWN_BLOCK_TIMEOUT_SECONDS = 12.0     # :43
+
+
+@dataclass(order=True)
+class _Delayed:
+    due: float
+    seq: int
+    event: object = field(compare=False)
+
+
+class ReprocessQueue:
+    def __init__(self, now: Optional[Callable[[], float]] = None):
+        self._now = now or time.monotonic
+        self._heap: List[_Delayed] = []
+        self._seq = 0
+        # block_root -> parked events waiting for that block
+        self._awaiting_block: Dict[bytes, List[object]] = {}
+        self._lock = threading.Lock()
+
+    # ---------------------------------------------------------------- intake
+
+    def queue_until(self, event, due: float) -> None:
+        with self._lock:
+            self._seq += 1
+            heapq.heappush(self._heap, _Delayed(due, self._seq, event))
+
+    def queue_early_block(self, event, slot_start: float) -> None:
+        self.queue_until(event, slot_start + EARLY_BLOCK_PAD_SECONDS)
+
+    def queue_unknown_block_attestation(self, event, block_root: bytes) -> None:
+        with self._lock:
+            self._awaiting_block.setdefault(bytes(block_root), []).append(event)
+        # timeout: re-deliver regardless so the failure surfaces
+        self.queue_until(
+            ("timeout", bytes(block_root), event),
+            self._now() + UNKNOWN_BLOCK_TIMEOUT_SECONDS,
+        )
+
+    # -------------------------------------------------------------- delivery
+
+    def block_imported(self, block_root: bytes) -> List[object]:
+        """Release everything parked on this root (the reprocess trigger)."""
+        with self._lock:
+            return self._awaiting_block.pop(bytes(block_root), [])
+
+    def poll(self) -> List[object]:
+        """Events whose deadline has passed."""
+        now = self._now()
+        out = []
+        with self._lock:
+            while self._heap and self._heap[0].due <= now:
+                item = heapq.heappop(self._heap).event
+                if isinstance(item, tuple) and item[0] == "timeout":
+                    _, root, event = item
+                    parked = self._awaiting_block.get(root)
+                    if parked and event in parked:
+                        parked.remove(event)
+                        if not parked:
+                            del self._awaiting_block[root]
+                        out.append(event)
+                    # else: already released by block_imported
+                else:
+                    out.append(item)
+        return out
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._heap) + sum(
+                len(v) for v in self._awaiting_block.values()
+            )

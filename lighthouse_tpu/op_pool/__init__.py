@@ -1,0 +1,6 @@
+"""Operation pool (reference: beacon_node/operation_pool, SURVEY.md §2.3)."""
+
+from .max_cover import MaxCoverItem, maximum_cover
+from .pool import OperationPool
+
+__all__ = ["MaxCoverItem", "OperationPool", "maximum_cover"]

@@ -1,0 +1,41 @@
+"""Greedy maximum-coverage packing.
+
+Mirror of operation_pool/src/max_cover.rs:11-31: items expose a covering
+set + weight; `maximum_cover` greedily takes the best item, removes its
+coverage from the rest, and repeats up to the limit. The classic (1 - 1/e)
+approximation — same algorithm the reference ships.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, List, Set, Tuple, TypeVar
+
+T = TypeVar("T")
+
+
+class MaxCoverItem:
+    """Wrap an object with its covering set {key: weight}."""
+
+    def __init__(self, obj, covering: dict):
+        self.obj = obj
+        self.covering = dict(covering)
+
+    def score(self) -> int:
+        return sum(self.covering.values())
+
+
+def maximum_cover(items: Iterable[MaxCoverItem], limit: int) -> List[MaxCoverItem]:
+    pool = [it for it in items if it.score() > 0]
+    out: List[MaxCoverItem] = []
+    while pool and len(out) < limit:
+        best_i = max(range(len(pool)), key=lambda i: pool[i].score())
+        best = pool.pop(best_i)
+        if best.score() == 0:
+            break
+        out.append(best)
+        covered = set(best.covering)
+        for it in pool:
+            for k in covered:
+                it.covering.pop(k, None)
+        pool = [it for it in pool if it.score() > 0]
+    return out

@@ -1,0 +1,219 @@
+"""OperationPool — attestations/slashings/exits/BLS-changes for block packing.
+
+Mirror of operation_pool/src/lib.rs: attestations aggregate on insert
+(disjoint bitfields OR together, signatures aggregate — naive_aggregation_pool
+folded in); `get_attestations` (:248) scores each aggregate by the fresh
+participation reward it would add (attestation.rs AttMaxCover) and packs
+MAX_ATTESTATIONS via greedy max-cover; slashings/exits deduplicate by the
+validators they affect; everything SSZ-persists across restarts
+(persistence.rs) via the store's OpPool column.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from lighthouse_tpu.crypto.bls import api as bls
+from lighthouse_tpu.state_transition import helpers as h
+from lighthouse_tpu.types.spec import TIMELY_TARGET_FLAG_INDEX
+
+from .max_cover import MaxCoverItem, maximum_cover
+
+
+class OperationPool:
+    def __init__(self, types, spec):
+        self.types = types
+        self.spec = spec
+        self._lock = threading.Lock()
+        # att_data_root -> list of (bits tuple, Attestation) disjoint aggregates
+        self._attestations: Dict[bytes, List[Tuple[tuple, object]]] = {}
+        self._att_data: Dict[bytes, object] = {}
+        self._proposer_slashings: Dict[int, object] = {}   # proposer idx -> op
+        self._attester_slashings: List[object] = []
+        self._exits: Dict[int, object] = {}                # validator idx -> op
+        self._bls_changes: Dict[int, object] = {}
+
+    # ---------------------------------------------------------- attestations
+
+    def insert_attestation(self, attestation, indexed_attestation=None) -> None:
+        """Aggregate into the pool: OR into the first disjoint aggregate, or
+        start a new one (lib.rs insert_attestation)."""
+        t = self.types
+        data_root = t.AttestationData.hash_tree_root(attestation.data)
+        bits = tuple(bool(b) for b in attestation.aggregation_bits)
+        with self._lock:
+            self._att_data[data_root] = attestation.data
+            groups = self._attestations.setdefault(data_root, [])
+            for i, (existing_bits, existing_att) in enumerate(groups):
+                if len(existing_bits) != len(bits):
+                    continue
+                overlap = any(a and b for a, b in zip(existing_bits, bits))
+                if not overlap:
+                    merged_bits = tuple(
+                        a or b for a, b in zip(existing_bits, bits)
+                    )
+                    merged_sig = bls.AggregateSignature.aggregate([
+                        bls.Signature.from_bytes(bytes(existing_att.signature)),
+                        bls.Signature.from_bytes(bytes(attestation.signature)),
+                    ])
+                    merged = t.Attestation(
+                        aggregation_bits=list(merged_bits),
+                        data=attestation.data,
+                        signature=bls.Signature(
+                            point=merged_sig.point, subgroup_checked=True
+                        ).to_bytes(),
+                    )
+                    groups[i] = (merged_bits, merged)
+                    return
+                if all((not b) or a for a, b in zip(existing_bits, bits)):
+                    return  # already fully covered by this aggregate
+            groups.append((bits, attestation))
+
+    def num_attestations(self) -> int:
+        with self._lock:
+            return sum(len(g) for g in self._attestations.values())
+
+    def get_attestations(self, state, committees_fn) -> List[object]:
+        """Pack attestations for a block on `state` via greedy max-cover.
+
+        `committees_fn(slot, index) -> List[validator_index]` resolves
+        committees (the chain's shuffling cache). Weight of an attestation =
+        sum of effective balances of attesters whose target-participation
+        flag isn't set yet (the AttMaxCover reward proxy)."""
+        spec = self.spec
+        P = spec.preset
+        current_epoch = h.get_current_epoch(state, spec)
+        previous_epoch = h.get_previous_epoch(state, spec)
+
+        items = []
+        with self._lock:
+            snapshot = [
+                (data_root, bits, att)
+                for data_root, groups in self._attestations.items()
+                for (bits, att) in groups
+            ]
+        for _, bits, att in snapshot:
+            data = att.data
+            target_epoch = data.target.epoch
+            if target_epoch == current_epoch:
+                participation = state.current_epoch_participation
+            elif target_epoch == previous_epoch:
+                participation = state.previous_epoch_participation
+            else:
+                continue
+            if data.slot + P.SLOTS_PER_EPOCH < state.slot:
+                continue  # too old to include
+            if data.slot >= state.slot:
+                continue  # not yet includable
+            try:
+                committee = committees_fn(data.slot, data.index)
+            except Exception:
+                continue
+            if len(committee) != len(bits):
+                continue
+            covering = {}
+            for v, b in zip(committee, bits):
+                if not b:
+                    continue
+                flags = participation[v] if v < len(participation) else 0
+                if not (flags >> TIMELY_TARGET_FLAG_INDEX) & 1:
+                    covering[v] = state.validators[v].effective_balance
+            items.append(MaxCoverItem(att, covering))
+
+        best = maximum_cover(items, P.MAX_ATTESTATIONS)
+        return [it.obj for it in best]
+
+    def prune_attestations(self, current_epoch: int) -> None:
+        spec = self.spec
+        with self._lock:
+            stale = [
+                root for root, data in self._att_data.items()
+                if data.target.epoch + 1 < current_epoch
+            ]
+            for root in stale:
+                self._attestations.pop(root, None)
+                self._att_data.pop(root, None)
+
+    # ------------------------------------------------- slashings/exits/misc
+
+    def insert_proposer_slashing(self, slashing) -> None:
+        with self._lock:
+            idx = slashing.signed_header_1.message.proposer_index
+            self._proposer_slashings.setdefault(idx, slashing)
+
+    def insert_attester_slashing(self, slashing) -> None:
+        with self._lock:
+            self._attester_slashings.append(slashing)
+
+    def insert_voluntary_exit(self, signed_exit) -> None:
+        with self._lock:
+            self._exits.setdefault(signed_exit.message.validator_index, signed_exit)
+
+    def insert_bls_to_execution_change(self, signed_change) -> None:
+        with self._lock:
+            self._bls_changes.setdefault(
+                signed_change.message.validator_index, signed_change
+            )
+
+    def get_slashings_and_exits(self, state):
+        """Ops still valid against `state` (get_slashings_and_exits)."""
+        P = self.spec.preset
+        epoch = h.get_current_epoch(state, self.spec)
+        with self._lock:
+            proposer = [
+                s for idx, s in self._proposer_slashings.items()
+                if idx < len(state.validators)
+                and not state.validators[idx].slashed
+            ][: P.MAX_PROPOSER_SLASHINGS]
+            attester = self._attester_slashings[: P.MAX_ATTESTER_SLASHINGS]
+            exits = [
+                e for idx, e in self._exits.items()
+                if idx < len(state.validators)
+                and state.validators[idx].exit_epoch == 2**64 - 1
+            ][: P.MAX_VOLUNTARY_EXITS]
+        return proposer, attester, exits
+
+    def get_bls_to_execution_changes(self, state):
+        P = self.spec.preset
+        with self._lock:
+            out = []
+            for idx, ch in self._bls_changes.items():
+                if idx >= len(state.validators):
+                    continue
+                creds = bytes(state.validators[idx].withdrawal_credentials)
+                if creds[:1] == b"\x00":  # still BLS credentials
+                    out.append(ch)
+            return out[: P.MAX_BLS_TO_EXECUTION_CHANGES]
+
+    # ----------------------------------------------------------- persistence
+
+    def persist(self, store) -> None:
+        """SSZ the pooled ops into the store (persistence.rs)."""
+        from lighthouse_tpu.store.kv import DBColumn
+
+        t = self.types
+        with self._lock:
+            atts = [att for groups in self._attestations.values()
+                    for (_, att) in groups]
+            blob = len(atts).to_bytes(4, "little") + b"".join(
+                len(s := t.Attestation.serialize(a)).to_bytes(4, "little") + s
+                for a in atts
+            )
+        store.hot.put(DBColumn.OpPool, b"attestations", blob)
+
+    def restore(self, store) -> None:
+        from lighthouse_tpu.store.kv import DBColumn
+
+        t = self.types
+        blob = store.hot.get(DBColumn.OpPool, b"attestations")
+        if blob is None:
+            return
+        n = int.from_bytes(blob[:4], "little")
+        off = 4
+        for _ in range(n):
+            ln = int.from_bytes(blob[off:off + 4], "little")
+            off += 4
+            att = t.Attestation.deserialize(blob[off:off + ln])
+            off += ln
+            self.insert_attestation(att)

@@ -1,0 +1,111 @@
+"""Beacon processor: priority ordering, batch forming, queue caps, delayed
+reprocessing (reference: beacon_processor/src/lib.rs manager tests +
+work_reprocessing_queue.rs)."""
+
+from lighthouse_tpu.beacon_processor import (
+    DEFAULT_MAX_BATCH,
+    BeaconProcessor,
+    ReprocessQueue,
+    WorkEvent,
+)
+
+
+def test_priority_order_blocks_before_attestations():
+    bp = BeaconProcessor()
+    log = []
+    bp.send(WorkEvent("gossip_attestation", "att1",
+                      process_individual=lambda x: log.append(x)))
+    bp.send(WorkEvent("gossip_block", "block",
+                      process_individual=lambda x: log.append(x)))
+    bp.send(WorkEvent("gossip_aggregate", "agg",
+                      process_individual=lambda x: log.append(x)))
+    bp.run_until_idle()
+    assert log == ["block", "agg", "att1"]
+
+
+def test_batch_forming_caps_at_max():
+    bp = BeaconProcessor(max_batch=64)
+    batches = []
+    singles = []
+    for i in range(100):
+        bp.send(WorkEvent(
+            "gossip_attestation", i,
+            process_individual=lambda x: singles.append(x),
+            process_batch=lambda xs: batches.append(list(xs)),
+        ))
+    bp.run_until_idle()
+    assert [len(b) for b in batches] == [64, 36]
+    assert singles == []
+    assert bp.stats.batched_items == 100
+
+
+def test_single_item_uses_individual_path():
+    bp = BeaconProcessor()
+    batches, singles = [], []
+    bp.send(WorkEvent(
+        "gossip_attestation", "only",
+        process_individual=lambda x: singles.append(x),
+        process_batch=lambda xs: batches.append(xs),
+    ))
+    bp.run_until_idle()
+    assert singles == ["only"] and batches == []
+
+
+def test_queue_cap_drops():
+    bp = BeaconProcessor()
+    sent = sum(
+        bp.send(WorkEvent("chain_segment", i)) for i in range(100)
+    )
+    assert sent == 64  # chain_segment cap
+    assert bp.stats.dropped == 36
+
+
+def test_threaded_mode_drains():
+    import time
+
+    bp = BeaconProcessor()
+    done = []
+    bp.start()
+    try:
+        for i in range(200):
+            bp.send(WorkEvent("gossip_attestation", i,
+                              process_individual=lambda x: done.append(x),
+                              process_batch=lambda xs: done.extend(xs)))
+        deadline = time.time() + 5
+        while len(done) < 200 and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        bp.stop()
+    assert sorted(done) == list(range(200))
+
+
+def test_reprocess_early_block():
+    t = [100.0]
+    rq = ReprocessQueue(now=lambda: t[0])
+    rq.queue_early_block("block_at_5", slot_start=101.0)
+    assert rq.poll() == []
+    t[0] = 101.01
+    assert rq.poll() == ["block_at_5"]
+    assert rq.pending() == 0
+
+
+def test_reprocess_unknown_block_released_on_import():
+    t = [0.0]
+    rq = ReprocessQueue(now=lambda: t[0])
+    root = b"\xaa" * 32
+    rq.queue_unknown_block_attestation("att", root)
+    assert rq.poll() == []
+    released = rq.block_imported(root)
+    assert released == ["att"]
+    # the timeout entry must NOT re-deliver after release
+    t[0] = 13.0
+    assert rq.poll() == []
+
+
+def test_reprocess_unknown_block_times_out():
+    t = [0.0]
+    rq = ReprocessQueue(now=lambda: t[0])
+    rq.queue_unknown_block_attestation("att", b"\xbb" * 32)
+    t[0] = 12.5
+    assert rq.poll() == ["att"]
+    assert rq.block_imported(b"\xbb" * 32) == []

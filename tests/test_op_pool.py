@@ -1,0 +1,133 @@
+"""Operation pool: on-insert aggregation, max-cover packing, production
+integration (reference: operation_pool/src tests + max_cover.rs examples)."""
+
+import pytest
+
+from lighthouse_tpu.op_pool import MaxCoverItem, OperationPool, maximum_cover
+from lighthouse_tpu.testing.harness import BeaconChainHarness
+
+
+def test_maximum_cover_greedy():
+    items = [
+        MaxCoverItem("a", {1: 1, 2: 1, 3: 1}),
+        MaxCoverItem("b", {3: 1, 4: 1}),
+        MaxCoverItem("c", {4: 1, 5: 1, 6: 1, 7: 1}),
+        MaxCoverItem("d", {1: 1}),
+    ]
+    best = maximum_cover(items, 2)
+    assert [it.obj for it in best] == ["c", "a"]
+    # second pick's coverage excludes what "c" already covered
+    assert best[1].score() == 3
+
+
+def test_maximum_cover_respects_limit_and_zero_scores():
+    items = [MaxCoverItem("x", {}), MaxCoverItem("y", {1: 5})]
+    best = maximum_cover(items, 5)
+    assert [it.obj for it in best] == ["y"]
+
+
+@pytest.fixture(scope="module")
+def rig():
+    h = BeaconChainHarness(n_validators=64)
+    h.chain.op_pool = OperationPool(h.types, h.spec)
+    h.extend_chain(2, attest=False)
+    return h
+
+
+def test_insert_aggregates_disjoint_singles(rig):
+    chain = rig.chain
+    pool = chain.op_pool
+    slot = rig.current_slot
+    atts = rig.make_attestations(slot)
+    committee = chain.committees_at(slot).committee(slot, 0)
+
+    for pos in range(len(committee)):
+        pool.insert_attestation(rig.single_attestation(atts[0], pos, committee))
+    # all singles merged into ONE aggregate with all bits set
+    assert pool.num_attestations() == 1
+    root = rig.types.AttestationData.hash_tree_root(atts[0].data)
+    bits, merged = pool._attestations[root][0]
+    assert all(bits)
+
+    # the merged aggregate's signature verifies like the harness aggregate
+    from lighthouse_tpu.crypto.bls import api as bls
+
+    expected = rig.types.Attestation.serialize(atts[0])
+    assert rig.types.Attestation.serialize(merged) == expected
+
+
+def test_get_attestations_packs_and_produces(rig):
+    chain = rig.chain
+    pool = chain.op_pool
+    rig.advance_slot()
+    slot = rig.current_slot
+    prev_atts = rig.make_attestations(slot - 1)
+    for att in prev_atts:
+        pool.insert_attestation(att)
+
+    committees_fn = lambda s, i: chain.committees_at(s).committee(s, i)
+    state = chain.head_state_clone_at(slot).copy()
+    from lighthouse_tpu.state_transition import slot_processing as sp
+
+    sp.process_slots(state, rig.types, rig.spec, slot,
+                     fork=chain.fork_at(slot))
+    packed = pool.get_attestations(state, committees_fn)
+    assert len(packed) == len(prev_atts)  # disjoint committees all add reward
+
+    # produce + import a block carrying them
+    proposer_state = chain.head_state_clone_at(slot)
+    import lighthouse_tpu.state_transition.helpers as h
+
+    block, post = chain.produce_block(
+        slot, randao_reveal=rig.randao_reveal(
+            proposer_state, rig.spec.epoch_at_slot(slot),
+            h.get_beacon_proposer_index(
+                (lambda s: (sp.process_slots(s, rig.types, rig.spec, slot,
+                                             fork=chain.fork_at(slot)), s)[1])(
+                    chain.state_for_block_import(chain.head.block_root)
+                ),
+                rig.spec,
+            ),
+        )
+    )
+    assert len(block.body.attestations) == len(prev_atts)
+    signed = rig.sign_block(chain.head_state_for_signatures(), block,
+                            chain.fork_at(slot))
+    chain.process_block(signed)
+    assert chain.head.state.slot == slot
+
+
+def test_duplicate_coverage_not_double_packed(rig):
+    """An attestation whose voters already have their target flag set scores
+    zero and is dropped by max-cover."""
+    chain = rig.chain
+    pool = chain.op_pool
+    # all attesters of the last packed block already voted; re-inserting the
+    # same attestations then packing against the post-state yields nothing new
+    state = chain.head.state
+    committees_fn = lambda s, i: chain.committees_at(s).committee(s, i)
+    packed = pool.get_attestations(state, committees_fn)
+    assert packed == []
+
+
+def test_exit_and_slashing_pools(rig):
+    chain = rig.chain
+    pool = chain.op_pool
+    t = rig.types
+    exit_msg = t.VoluntaryExit(epoch=0, validator_index=3)
+    signed = t.SignedVoluntaryExit(message=exit_msg, signature=b"\x00" * 96)
+    pool.insert_voluntary_exit(signed)
+    pool.insert_voluntary_exit(signed)  # dedup by validator
+    _, _, exits = pool.get_slashings_and_exits(chain.head.state)
+    assert len(exits) == 1
+
+
+def test_persistence_roundtrip(rig):
+    chain = rig.chain
+    pool = chain.op_pool
+    n_before = pool.num_attestations()
+    assert n_before > 0
+    pool.persist(chain.store)
+    fresh = OperationPool(rig.types, rig.spec)
+    fresh.restore(chain.store)
+    assert fresh.num_attestations() == n_before
