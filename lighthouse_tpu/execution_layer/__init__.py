@@ -1,0 +1,16 @@
+"""Execution-engine bridge (reference: beacon_node/execution_layer, L6)."""
+
+from .engine_api import EngineApiError, HttpJsonRpc, make_jwt
+from .execution_layer import ExecutionLayer, ExecutionLayerError
+from .mock import MockEngineServer, MockExecutionEngine, compute_block_hash
+
+__all__ = [
+    "EngineApiError",
+    "ExecutionLayer",
+    "ExecutionLayerError",
+    "HttpJsonRpc",
+    "MockEngineServer",
+    "MockExecutionEngine",
+    "compute_block_hash",
+    "make_jwt",
+]

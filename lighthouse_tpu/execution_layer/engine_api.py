@@ -1,0 +1,153 @@
+"""Engine API plumbing: JSON-RPC client with JWT auth.
+
+Mirror of execution_layer/src/engine_api/http.rs: HTTP POST JSON-RPC with an
+HS256 JWT minted per request from the shared hex secret (auth.rs), methods
+engine_newPayloadV2/V3, engine_forkchoiceUpdatedV2/V3, engine_getPayloadV2/V3
+and eth_* block queries. stdlib-only (urllib + hmac).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+
+class EngineApiError(Exception):
+    pass
+
+
+def _b64url(data: bytes) -> bytes:
+    return base64.urlsafe_b64encode(data).rstrip(b"=")
+
+
+def make_jwt(secret: bytes, issued_at: Optional[int] = None) -> str:
+    """HS256 JWT with an `iat` claim (the engine-API auth scheme)."""
+    header = _b64url(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    claims = _b64url(
+        json.dumps({"iat": issued_at or int(time.time())}).encode()
+    )
+    signing_input = header + b"." + claims
+    sig = hmac.new(secret, signing_input, hashlib.sha256).digest()
+    return (signing_input + b"." + _b64url(sig)).decode()
+
+
+class HttpJsonRpc:
+    def __init__(self, url: str, jwt_secret: Optional[bytes] = None,
+                 timeout: float = 8.0):
+        self.url = url
+        self.jwt_secret = jwt_secret
+        self.timeout = timeout
+        self._id = 0
+
+    def call(self, method: str, params: List[Any]) -> Any:
+        self._id += 1
+        body = json.dumps({
+            "jsonrpc": "2.0", "id": self._id,
+            "method": method, "params": params,
+        }).encode()
+        req = urllib.request.Request(
+            self.url, data=body, headers={"Content-Type": "application/json"}
+        )
+        if self.jwt_secret is not None:
+            req.add_header("Authorization", f"Bearer {make_jwt(self.jwt_secret)}")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                payload = json.loads(resp.read())
+        except Exception as e:
+            raise EngineApiError(f"rpc transport error: {e}") from e
+        if "error" in payload and payload["error"]:
+            raise EngineApiError(f"rpc error: {payload['error']}")
+        return payload.get("result")
+
+
+# --- wire formats (camelCase hex quantities, engine_api/json_structures) ----
+
+
+def _hex(n: int) -> str:
+    return hex(n)
+
+
+def _hexb(b: bytes) -> str:
+    return "0x" + bytes(b).hex()
+
+
+def payload_to_json(payload) -> Dict[str, Any]:
+    out = {
+        "parentHash": _hexb(payload.parent_hash),
+        "feeRecipient": _hexb(payload.fee_recipient),
+        "stateRoot": _hexb(payload.state_root),
+        "receiptsRoot": _hexb(payload.receipts_root),
+        "logsBloom": _hexb(payload.logs_bloom),
+        "prevRandao": _hexb(payload.prev_randao),
+        "blockNumber": _hex(payload.block_number),
+        "gasLimit": _hex(payload.gas_limit),
+        "gasUsed": _hex(payload.gas_used),
+        "timestamp": _hex(payload.timestamp),
+        "extraData": _hexb(payload.extra_data),
+        "baseFeePerGas": _hex(payload.base_fee_per_gas),
+        "blockHash": _hexb(payload.block_hash),
+        "transactions": [_hexb(tx) for tx in payload.transactions],
+    }
+    if hasattr(payload, "withdrawals"):
+        out["withdrawals"] = [
+            {
+                "index": _hex(w.index),
+                "validatorIndex": _hex(w.validator_index),
+                "address": _hexb(w.address),
+                "amount": _hex(w.amount),
+            }
+            for w in payload.withdrawals
+        ]
+    if hasattr(payload, "blob_gas_used"):
+        out["blobGasUsed"] = _hex(payload.blob_gas_used)
+        out["excessBlobGas"] = _hex(payload.excess_blob_gas)
+    return out
+
+
+def json_to_payload(types, obj: Dict[str, Any], fork: str):
+    def ib(h):
+        return bytes.fromhex(h[2:])
+
+    def ii(h):
+        return int(h, 16)
+
+    kwargs = dict(
+        parent_hash=ib(obj["parentHash"]),
+        fee_recipient=ib(obj["feeRecipient"]),
+        state_root=ib(obj["stateRoot"]),
+        receipts_root=ib(obj["receiptsRoot"]),
+        logs_bloom=ib(obj["logsBloom"]),
+        prev_randao=ib(obj["prevRandao"]),
+        block_number=ii(obj["blockNumber"]),
+        gas_limit=ii(obj["gasLimit"]),
+        gas_used=ii(obj["gasUsed"]),
+        timestamp=ii(obj["timestamp"]),
+        extra_data=ib(obj["extraData"]),
+        base_fee_per_gas=ii(obj["baseFeePerGas"]),
+        block_hash=ib(obj["blockHash"]),
+        transactions=[ib(tx) for tx in obj["transactions"]],
+    )
+    cls = {
+        "bellatrix": types.ExecutionPayloadBellatrix,
+        "capella": types.ExecutionPayloadCapella,
+        "deneb": types.ExecutionPayloadDeneb,
+    }[fork]
+    if fork in ("capella", "deneb"):
+        kwargs["withdrawals"] = [
+            types.Withdrawal(
+                index=ii(w["index"]),
+                validator_index=ii(w["validatorIndex"]),
+                address=ib(w["address"]),
+                amount=ii(w["amount"]),
+            )
+            for w in obj.get("withdrawals", [])
+        ]
+    if fork == "deneb":
+        kwargs["blob_gas_used"] = ii(obj.get("blobGasUsed", "0x0"))
+        kwargs["excess_blob_gas"] = ii(obj.get("excessBlobGas", "0x0"))
+    return cls(**kwargs)

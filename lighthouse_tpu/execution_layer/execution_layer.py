@@ -1,0 +1,154 @@
+"""ExecutionLayer — the consensus-side driver of an execution engine.
+
+Mirror of beacon_node/execution_layer/src/lib.rs:373: `notify_new_payload`
+(:1324) returns the interpreted payload status, `notify_forkchoice_updated`
+drives head/finalized on the EL (with the reference's lock discipline
+reduced to one mutex), `get_payload` (:785) runs the two-phase
+forkchoiceUpdated(payload_attributes) -> getPayload build flow. Payload
+status interpretation mirrors payload_status.rs (INVALID_BLOCK_HASH and
+ACCEPTED both collapse into the tri-state VALID/INVALID/SYNCING the chain
+consumes). An EngineState watchdog tracks online/offline transitions
+(engines.rs:596).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from .engine_api import EngineApiError, HttpJsonRpc, json_to_payload, payload_to_json
+
+
+class ExecutionLayerError(Exception):
+    pass
+
+
+class ExecutionLayer:
+    def __init__(self, engine, types=None, fork: str = "capella",
+                 fee_recipient: bytes = b"\x00" * 20):
+        """`engine` is anything exposing the engine-API surface: a
+        MockExecutionEngine directly, or `ExecutionLayer.http(url, secret)`
+        for a real endpoint."""
+        self.engine = engine
+        self.types = types
+        self.fork = fork
+        self.fee_recipient = fee_recipient
+        self.engine_online = True
+        self._lock = threading.Lock()
+
+    @classmethod
+    def http(cls, url: str, jwt_secret: bytes, types, fork: str = "capella"):
+        return cls(_HttpEngine(HttpJsonRpc(url, jwt_secret), types, fork),
+                   types=types, fork=fork)
+
+    # ----------------------------------------------------------- new payload
+
+    def notify_new_payload(self, payload) -> str:
+        """-> "VALID" | "INVALID" | "SYNCING" (payload_status.rs collapse)."""
+        with self._lock:
+            try:
+                status = self.engine.new_payload(payload)
+                self.engine_online = True
+            except EngineApiError:
+                self.engine_online = False
+                return "SYNCING"  # EL offline => optimistic import
+        s = status.get("status", "SYNCING")
+        if s in ("VALID",):
+            return "VALID"
+        if s in ("INVALID", "INVALID_BLOCK_HASH"):
+            return "INVALID"
+        return "SYNCING"  # SYNCING | ACCEPTED
+
+    # ------------------------------------------------------------ forkchoice
+
+    def notify_forkchoice_updated(
+        self,
+        head_block_hash: bytes,
+        safe_block_hash: bytes,
+        finalized_block_hash: bytes,
+        payload_attributes: Optional[Dict[str, Any]] = None,
+    ):
+        with self._lock:
+            try:
+                out = self.engine.forkchoice_updated(
+                    head_block_hash, safe_block_hash, finalized_block_hash,
+                    payload_attributes,
+                )
+                self.engine_online = True
+                return out
+            except EngineApiError:
+                self.engine_online = False
+                return {"payloadStatus": {"status": "SYNCING"}, "payloadId": None}
+
+    # ----------------------------------------------------------- get payload
+
+    def get_payload(self, parent_hash: bytes, timestamp: int,
+                    prev_randao: bytes, withdrawals: Optional[List] = None):
+        """Two-phase build: fcU(attributes) -> payloadId -> getPayload."""
+        attrs = {
+            "timestamp": timestamp,
+            "prevRandao": prev_randao,
+            "suggestedFeeRecipient": self.fee_recipient,
+            "withdrawals": withdrawals or [],
+        }
+        out = self.notify_forkchoice_updated(
+            parent_hash, parent_hash, parent_hash, attrs
+        )
+        payload_id = out.get("payloadId")
+        if payload_id is None:
+            raise ExecutionLayerError("engine did not return a payloadId")
+        with self._lock:
+            return self.engine.get_payload(payload_id)
+
+
+class _HttpEngine:
+    """Engine surface over JSON-RPC (engine_api/http.rs)."""
+
+    def __init__(self, rpc: HttpJsonRpc, types, fork: str):
+        self.rpc = rpc
+        self.types = types
+        self.fork = fork
+
+    def new_payload(self, payload) -> Dict[str, Any]:
+        version = "engine_newPayloadV3" if self.fork == "deneb" else \
+            "engine_newPayloadV2"
+        params = [payload_to_json(payload)]
+        if self.fork == "deneb":
+            params += [[], "0x" + b"\x00".hex() * 32]
+        return self.rpc.call(version, params)
+
+    def forkchoice_updated(self, head, safe, fin, attrs) -> Dict[str, Any]:
+        state = {
+            "headBlockHash": "0x" + bytes(head).hex(),
+            "safeBlockHash": "0x" + bytes(safe).hex(),
+            "finalizedBlockHash": "0x" + bytes(fin).hex(),
+        }
+        json_attrs = None
+        if attrs is not None:
+            json_attrs = {
+                "timestamp": hex(attrs["timestamp"]),
+                "prevRandao": "0x" + bytes(attrs["prevRandao"]).hex(),
+                "suggestedFeeRecipient": "0x" + bytes(
+                    attrs["suggestedFeeRecipient"]
+                ).hex(),
+                "withdrawals": [
+                    {
+                        "index": hex(w.index),
+                        "validatorIndex": hex(w.validator_index),
+                        "address": "0x" + bytes(w.address).hex(),
+                        "amount": hex(w.amount),
+                    }
+                    for w in attrs.get("withdrawals", [])
+                ],
+            }
+        version = "engine_forkchoiceUpdatedV3" if self.fork == "deneb" else \
+            "engine_forkchoiceUpdatedV2"
+        out = self.rpc.call(version, [state, json_attrs])
+        return out or {}
+
+    def get_payload(self, payload_id: str):
+        version = "engine_getPayloadV3" if self.fork == "deneb" else \
+            "engine_getPayloadV2"
+        out = self.rpc.call(version, [payload_id])
+        obj = out.get("executionPayload") if isinstance(out, dict) else out
+        return json_to_payload(self.types, obj, self.fork)

@@ -1,0 +1,204 @@
+"""Mock execution engine — a fake EL chain for tests and local nets.
+
+Mirror of execution_layer/src/test_utils/: `ExecutionBlockGenerator`
+maintains a hash-linked chain of execution blocks; `new_payload` validates
+parent linkage + recomputed block hash; `forkchoice_updated` tracks
+head/finalized and (with attributes) prepares a payload build job;
+`get_payload` assembles the next payload. `hooks` force SYNCING/INVALID
+statuses the way test_utils/hook.rs does for payload-invalidation tests.
+Optionally served over JSON-RPC via `MockEngineServer` (handle_rpc.rs) so
+the HTTP client path is exercised end-to-end.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from .engine_api import json_to_payload, payload_to_json
+
+
+def compute_block_hash(payload_like: Dict[str, Any]) -> bytes:
+    """Deterministic mock "keccak": SHA-256 over the ordered header fields
+    (block_hash.rs verifies real keccak RLP; the mock chain only needs
+    consistency between producer and verifier)."""
+    material = json.dumps(
+        {k: v for k, v in sorted(payload_like.items()) if k != "blockHash"},
+        sort_keys=True,
+    ).encode()
+    return hashlib.sha256(material).digest()
+
+
+class MockExecutionEngine:
+    def __init__(self, types, fork: str = "capella", terminal_block_hash: bytes = b"\x00" * 32):
+        self.types = types
+        self.fork = fork
+        self._lock = threading.Lock()
+        self.blocks: Dict[bytes, Dict[str, Any]] = {}
+        self.head_hash = terminal_block_hash
+        self.finalized_hash = b"\x00" * 32
+        self.payload_jobs: Dict[str, Dict[str, Any]] = {}
+        self._job_seq = 0
+        # Test hooks: set to force statuses (test_utils/hook.rs).
+        self.on_new_payload: Optional[Any] = None
+        self.genesis_hash = terminal_block_hash
+        self.blocks[terminal_block_hash] = {"blockNumber": "0x0", "blockHash": "0x" + terminal_block_hash.hex()}
+
+    # ----------------------------------------------------------- engine API
+
+    def new_payload(self, payload) -> Dict[str, Any]:
+        if self.on_new_payload is not None:
+            forced = self.on_new_payload(payload)
+            if forced is not None:
+                return {"status": forced}
+        with self._lock:
+            obj = payload_to_json(payload)
+            parent = bytes(payload.parent_hash)
+            if parent not in self.blocks:
+                return {"status": "SYNCING"}
+            if bytes(payload.block_hash) != compute_block_hash(obj):
+                return {"status": "INVALID_BLOCK_HASH"}
+            self.blocks[bytes(payload.block_hash)] = obj
+            return {"status": "VALID",
+                    "latestValidHash": "0x" + bytes(payload.block_hash).hex()}
+
+    def forkchoice_updated(self, head: bytes, safe: bytes, fin: bytes,
+                           attrs: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+        with self._lock:
+            head = bytes(head)
+            if head not in self.blocks:
+                return {"payloadStatus": {"status": "SYNCING"}, "payloadId": None}
+            self.head_hash = head
+            self.finalized_hash = bytes(fin)
+            payload_id = None
+            if attrs is not None:
+                self._job_seq += 1
+                payload_id = hex(self._job_seq)
+                self.payload_jobs[payload_id] = {
+                    "parent": head, "attrs": dict(attrs),
+                }
+            return {
+                "payloadStatus": {"status": "VALID",
+                                  "latestValidHash": "0x" + head.hex()},
+                "payloadId": payload_id,
+            }
+
+    def get_payload(self, payload_id: str):
+        with self._lock:
+            job = self.payload_jobs.pop(payload_id, None)
+            if job is None:
+                raise KeyError(f"unknown payloadId {payload_id}")
+            parent = job["parent"]
+            attrs = job["attrs"]
+            parent_number = int(self.blocks[parent].get("blockNumber", "0x0"), 16)
+            t = self.types
+            kwargs = dict(
+                parent_hash=parent,
+                fee_recipient=bytes(attrs.get("suggestedFeeRecipient", b"\x00" * 20)),
+                prev_randao=bytes(attrs["prevRandao"]),
+                block_number=parent_number + 1,
+                gas_limit=30_000_000,
+                timestamp=attrs["timestamp"],
+                block_hash=b"\x00" * 32,
+            )
+            cls = {
+                "bellatrix": t.ExecutionPayloadBellatrix,
+                "capella": t.ExecutionPayloadCapella,
+                "deneb": t.ExecutionPayloadDeneb,
+            }[self.fork]
+            if self.fork in ("capella", "deneb"):
+                kwargs["withdrawals"] = list(attrs.get("withdrawals", []))
+            payload = cls(**kwargs)
+            payload.block_hash = compute_block_hash(payload_to_json(payload))
+            return payload
+
+
+# ---------------------------------------------------------------------------
+# JSON-RPC server wrapper
+# ---------------------------------------------------------------------------
+
+
+class MockEngineServer:
+    """Serve a MockExecutionEngine over HTTP JSON-RPC (handle_rpc.rs)."""
+
+    def __init__(self, engine: MockExecutionEngine, port: int = 0):
+        self.engine = engine
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length))
+                try:
+                    result = outer._dispatch(req["method"], req.get("params", []))
+                    body = {"jsonrpc": "2.0", "id": req.get("id"), "result": result}
+                except Exception as e:
+                    body = {
+                        "jsonrpc": "2.0", "id": req.get("id"),
+                        "error": {"code": -32000, "message": str(e)},
+                    }
+                data = json.dumps(body).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self.server.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+    def _dispatch(self, method: str, params: List[Any]):
+        e = self.engine
+        t = e.types
+
+        def ib(h):
+            return bytes.fromhex(h[2:])
+
+        if method.startswith("engine_newPayload"):
+            payload = json_to_payload(t, params[0], e.fork)
+            return e.new_payload(payload)
+        if method.startswith("engine_forkchoiceUpdated"):
+            state = params[0]
+            attrs = params[1]
+            parsed_attrs = None
+            if attrs:
+                parsed_attrs = {
+                    "timestamp": int(attrs["timestamp"], 16),
+                    "prevRandao": ib(attrs["prevRandao"]),
+                    "suggestedFeeRecipient": ib(attrs["suggestedFeeRecipient"]),
+                    "withdrawals": [
+                        t.Withdrawal(
+                            index=int(w["index"], 16),
+                            validator_index=int(w["validatorIndex"], 16),
+                            address=ib(w["address"]),
+                            amount=int(w["amount"], 16),
+                        )
+                        for w in attrs.get("withdrawals", [])
+                    ],
+                }
+            return e.forkchoice_updated(
+                ib(state["headBlockHash"]), ib(state["safeBlockHash"]),
+                ib(state["finalizedBlockHash"]), parsed_attrs,
+            )
+        if method.startswith("engine_getPayload"):
+            payload = e.get_payload(params[0])
+            return {"executionPayload": payload_to_json(payload)}
+        raise ValueError(f"unknown method {method}")

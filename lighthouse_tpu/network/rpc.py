@@ -1,0 +1,154 @@
+"""Req/Resp RPC: request/response streams with rate limiting.
+
+Mirror of lighthouse_network/src/rpc/: protocol-tagged requests, chunked
+responses (BlocksByRange streams one block per chunk), per-peer token-bucket
+rate limiting on both inbound (rate_limiter.rs) and outbound
+(self_limiter.rs), and error codes. Frames ride the same transport as
+gossip; payloads use the zlib framing seam from types.py.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .peer_manager import PeerAction
+from .types import Protocol, decode_frame, encode_frame
+
+RESP_SUCCESS = 0
+RESP_INVALID_REQUEST = 1
+RESP_SERVER_ERROR = 2
+RESP_RESOURCE_UNAVAILABLE = 3
+RESP_RATE_LIMITED = 139
+
+# Default quotas: (tokens, per_seconds) per protocol (rpc/config.rs defaults).
+DEFAULT_QUOTAS = {
+    Protocol.STATUS: (5, 15),
+    Protocol.GOODBYE: (1, 10),
+    Protocol.BLOCKS_BY_RANGE: (1024, 10),
+    Protocol.BLOCKS_BY_ROOT: (128, 10),
+    Protocol.BLOBS_BY_RANGE: (768, 10),
+    Protocol.BLOBS_BY_ROOT: (128, 10),
+    Protocol.PING: (2, 10),
+    Protocol.METADATA: (2, 5),
+}
+
+
+class TokenBucket:
+    def __init__(self, tokens: int, per_seconds: float, now=None):
+        self.capacity = tokens
+        self.refill = tokens / per_seconds
+        self.tokens = float(tokens)
+        self._now = now or time.monotonic
+        self.last = self._now()
+
+    def allow(self, cost: int = 1) -> bool:
+        t = self._now()
+        self.tokens = min(self.capacity, self.tokens + (t - self.last) * self.refill)
+        self.last = t
+        if self.tokens >= cost:
+            self.tokens -= cost
+            return True
+        return False
+
+
+class RpcHandler:
+    """Per-node RPC endpoint. Register server handlers per protocol; issue
+    requests with `request` (response delivered synchronously in-process)."""
+
+    def __init__(self, peer_id: str, transport, peer_manager=None, now=None):
+        self.peer_id = peer_id
+        self.transport = transport
+        self.peer_manager = peer_manager
+        self._now = now or time.monotonic
+        self.handlers: Dict[str, Callable] = {}
+        self._req_seq = 0
+        self._pending: Dict[int, List[Tuple[int, bytes]]] = {}
+        self._buckets: Dict[Tuple[str, str], TokenBucket] = {}
+        self._lock = threading.RLock()
+
+    def register(self, protocol: str, handler: Callable) -> None:
+        """handler(peer_id, request_bytes) -> List[response_chunk_bytes]"""
+        self.handlers[protocol] = handler
+
+    # ---------------------------------------------------------------- client
+
+    def request(self, dst: str, protocol: str, payload: bytes,
+                timeout: float = 10.0) -> List[bytes]:
+        """Send a request; returns decoded response chunks. Raises RpcError
+        on error codes."""
+        with self._lock:
+            self._req_seq += 1
+            req_id = self._req_seq
+            self._pending[req_id] = []
+        self.transport.send(
+            self.peer_id, dst,
+            ("rpc_req", req_id, protocol, encode_frame(payload)),
+        )
+        # In-process transport delivers synchronously; chunks are waiting.
+        with self._lock:
+            chunks = self._pending.pop(req_id, [])
+        out = []
+        for code, data in chunks:
+            if code != RESP_SUCCESS:
+                raise RpcError(code, data.decode("utf-8", "replace"))
+            out.append(data)
+        return out
+
+    # ---------------------------------------------------------------- server
+
+    def handle_frame(self, src: str, frame: tuple) -> None:
+        kind = frame[0]
+        if kind == "rpc_req":
+            _, req_id, protocol, enc = frame
+            payload, _ = decode_frame(enc)
+            self._serve(src, req_id, protocol, payload)
+        elif kind == "rpc_resp":
+            _, req_id, code, enc = frame
+            data, _ = decode_frame(enc) if enc else (b"", 0)
+            with self._lock:
+                if req_id in self._pending:
+                    self._pending[req_id].append((code, data))
+
+    def _serve(self, src: str, req_id: int, protocol: str, payload: bytes) -> None:
+        if not self._rate_ok(src, protocol):
+            self._respond(src, req_id, RESP_RATE_LIMITED, b"rate limited")
+            if self.peer_manager is not None:
+                self.peer_manager.report_peer(src, PeerAction.HIGH_TOLERANCE)
+            return
+        handler = self.handlers.get(protocol)
+        if handler is None:
+            self._respond(src, req_id, RESP_INVALID_REQUEST, b"unsupported")
+            return
+        try:
+            chunks = handler(src, payload)
+        except Exception as e:
+            self._respond(src, req_id, RESP_SERVER_ERROR, str(e).encode())
+            return
+        for chunk in chunks:
+            self._respond(src, req_id, RESP_SUCCESS, chunk)
+
+    def _respond(self, dst: str, req_id: int, code: int, data: bytes) -> None:
+        self.transport.send(
+            self.peer_id, dst, ("rpc_resp", req_id, code, encode_frame(data))
+        )
+
+    def _rate_ok(self, peer: str, protocol: str) -> bool:
+        quota = DEFAULT_QUOTAS.get(protocol)
+        if quota is None:
+            return True
+        key = (peer, protocol)
+        with self._lock:
+            bucket = self._buckets.get(key)
+            if bucket is None:
+                bucket = TokenBucket(quota[0], quota[1], now=self._now)
+                self._buckets[key] = bucket
+            return bucket.allow()
+
+
+class RpcError(Exception):
+    def __init__(self, code: int, message: str):
+        self.code = code
+        super().__init__(f"rpc error {code}: {message}")

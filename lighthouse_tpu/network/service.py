@@ -1,0 +1,294 @@
+"""NetworkService + Router — the node's network face.
+
+Mirror of beacon_node/network: `NetworkService` (service.rs:379,445) owns
+the gossip + RPC endpoints on one peer identity and the Status handshake;
+the `Router` (router.rs:269-409) maps gossip topics and RPC responses onto
+chain calls (directly, or through a BeaconProcessor when one is attached —
+network_beacon_processor/mod.rs enqueues Work with individual AND batch
+closures so attestations batch-verify on the device backend).
+
+Message wire format: 1-byte fork tag + SSZ (the store's scheme), zlib-framed
+by the transport layer.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from lighthouse_tpu.beacon_chain import AttestationError, BlockError
+from lighthouse_tpu.beacon_processor import BeaconProcessor, WorkEvent
+from lighthouse_tpu.network import sync as sync_mod
+from lighthouse_tpu.network.gossip import ACCEPT, IGNORE, REJECT, GossipNode
+from lighthouse_tpu.network.peer_manager import PeerAction, PeerManager
+from lighthouse_tpu.network.rpc import RpcError, RpcHandler
+from lighthouse_tpu.network.types import (
+    BlocksByRangeRequest,
+    BlocksByRootRequest,
+    Protocol,
+    Status,
+    attestation_subnet_topic,
+    beacon_aggregate_and_proof_topic,
+    beacon_block_topic,
+    compute_subnet_for_attestation,
+)
+from lighthouse_tpu.types.spec import compute_fork_digest
+
+
+class _NoRegisterTransport:
+    """Forwarding proxy so sub-endpoints don't claim the registry slot."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def send(self, src, dst, frame):
+        self._inner.send(src, dst, frame)
+
+
+class NetworkService:
+    def __init__(
+        self,
+        peer_id: str,
+        transport,
+        chain,
+        processor: Optional[BeaconProcessor] = None,
+    ):
+        self.peer_id = peer_id
+        self.transport = transport
+        self.chain = chain
+        self.processor = processor
+        self.peer_manager = PeerManager()
+        proxy = _NoRegisterTransport(transport)
+        self.gossip = GossipNode(peer_id, proxy, peer_manager=self.peer_manager)
+        self.rpc = RpcHandler(peer_id, proxy, peer_manager=self.peer_manager)
+        self.sync = sync_mod.SyncManager(self)
+        self.fork_digest = compute_fork_digest(
+            chain.spec.fork_version_for_name(chain.fork_at(chain.current_slot())),
+            bytes(chain.head.state.genesis_validators_root),
+        )
+        self._lock = threading.RLock()
+        if hasattr(transport, "register"):
+            transport.register(self)
+        self._register_rpc_servers()
+        self._subscribe_core_topics()
+
+    # --------------------------------------------------------------- routing
+
+    def handle_frame(self, src: str, frame: tuple) -> None:
+        if frame[0].startswith("rpc_"):
+            self.rpc.handle_frame(src, frame)
+        else:
+            self.gossip.handle_frame(src, frame)
+
+    # ------------------------------------------------------------ serializers
+
+    def _encode_block(self, signed_block) -> bytes:
+        fork = self.chain.fork_at(signed_block.message.slot)
+        from lighthouse_tpu.store.hot_cold import _FORK_TAGS
+
+        cls = self.chain.types.SignedBeaconBlock[fork]
+        return bytes([_FORK_TAGS[fork]]) + cls.serialize(signed_block)
+
+    def _decode_block(self, data: bytes):
+        from lighthouse_tpu.store.hot_cold import _TAG_FORKS
+
+        fork = _TAG_FORKS[data[0]]
+        return self.chain.types.SignedBeaconBlock[fork].deserialize(data[1:])
+
+    # ------------------------------------------------------------- handshake
+
+    def local_status(self) -> Status:
+        chain = self.chain
+        return Status(
+            fork_digest=self.fork_digest,
+            finalized_root=chain.fork_choice.finalized.root,
+            finalized_epoch=chain.fork_choice.finalized.epoch,
+            head_root=chain.head.block_root,
+            head_slot=chain.head.state.slot,
+        )
+
+    def connect(self, other: "NetworkService") -> None:
+        """Dial + handshake both ways (the swarm's dial→Status dance)."""
+        self.gossip._peer_connected(other.peer_id)
+        other.gossip._peer_connected(self.peer_id)
+        # Exchange Status over RPC.
+        chunks = self.rpc.request(
+            other.peer_id, Protocol.STATUS, self.local_status().to_bytes()
+        )
+        if chunks:
+            self.on_peer_status(other.peer_id, Status.from_bytes(chunks[0]))
+
+    def on_peer_status(self, peer_id: str, status: Status) -> None:
+        if status.fork_digest != self.fork_digest:
+            self.peer_manager.report_peer(peer_id, PeerAction.FATAL)
+            return
+        self.peer_manager.update_status(peer_id, status)
+        self.sync.on_peer_status(peer_id, status)
+
+    # ------------------------------------------------------------ rpc servers
+
+    def _register_rpc_servers(self) -> None:
+        self.rpc.register(Protocol.STATUS, self._serve_status)
+        self.rpc.register(Protocol.PING, lambda src, req: [req])
+        self.rpc.register(Protocol.GOODBYE, lambda src, req: [])
+        self.rpc.register(Protocol.BLOCKS_BY_RANGE, self._serve_blocks_by_range)
+        self.rpc.register(Protocol.BLOCKS_BY_ROOT, self._serve_blocks_by_root)
+        self.rpc.register(Protocol.METADATA, lambda src, req: [b"\x00" * 24])
+
+    def _serve_status(self, src: str, req: bytes) -> List[bytes]:
+        self.on_peer_status(src, Status.from_bytes(req))
+        return [self.local_status().to_bytes()]
+
+    def _serve_blocks_by_range(self, src: str, req: bytes) -> List[bytes]:
+        r = BlocksByRangeRequest.from_bytes(req)
+        count = min(r.count, 1024)
+        chain = self.chain
+        out = []
+        # Walk back from head collecting canonical blocks in the window.
+        roots = {}
+        for root, slot in chain.store.iter_block_roots_back(chain.head.block_root):
+            if slot < r.start_slot:
+                break
+            if slot < r.start_slot + count:
+                roots[slot] = root
+        for slot in sorted(roots):
+            block = chain.store.get_block(roots[slot])
+            if block is not None:
+                out.append(self._encode_block(block))
+        return out
+
+    def _serve_blocks_by_root(self, src: str, req: bytes) -> List[bytes]:
+        r = BlocksByRootRequest.from_bytes(req)
+        out = []
+        for root in r.roots[:128]:
+            block = self.chain.store.get_block(root)
+            if block is not None:
+                out.append(self._encode_block(block))
+        return out
+
+    # --------------------------------------------------------------- gossip
+
+    def _subscribe_core_topics(self) -> None:
+        fd = self.fork_digest
+        self.gossip.subscribe(
+            beacon_block_topic(fd),
+            validator=self._validate_block,
+        )
+        self.gossip.subscribe(
+            beacon_aggregate_and_proof_topic(fd),
+            validator=self._validate_aggregate,
+        )
+        for subnet in range(4):  # minimal-spec subnet spread; mainnet: 64
+            self.gossip.subscribe(
+                attestation_subnet_topic(subnet, fd),
+                validator=self._validate_attestation,
+            )
+
+    def publish_block(self, signed_block) -> int:
+        return self.gossip.publish(
+            beacon_block_topic(self.fork_digest), self._encode_block(signed_block)
+        )
+
+    def publish_attestation(self, attestation) -> int:
+        chain = self.chain
+        committees = chain.committees_at(attestation.data.slot)
+        subnet = compute_subnet_for_attestation(
+            chain.spec, attestation.data.slot, attestation.data.index,
+            committees.committees_per_slot,
+        ) % 4
+        data = chain.types.Attestation.serialize(attestation)
+        return self.gossip.publish(
+            attestation_subnet_topic(subnet, self.fork_digest), data
+        )
+
+    def publish_aggregate(self, signed_aggregate) -> int:
+        data = self.chain.types.SignedAggregateAndProof.serialize(signed_aggregate)
+        return self.gossip.publish(
+            beacon_aggregate_and_proof_topic(self.fork_digest), data
+        )
+
+    # ------------------------------------------------------- gossip validate
+    #
+    # Validators run inline (gossip propagation decision); heavy import work
+    # lands on the processor when attached (process_individual/batch split,
+    # network_beacon_processor/mod.rs:75-148).
+
+    def _validate_block(self, topic: str, data: bytes, origin: str) -> str:
+        try:
+            signed_block = self._decode_block(data)
+        except Exception:
+            return REJECT
+        try:
+            if self.processor is not None:
+                self.processor.send(WorkEvent(
+                    "gossip_block", signed_block,
+                    process_individual=self._import_gossip_block,
+                ))
+            else:
+                self._import_gossip_block(signed_block)
+            return ACCEPT
+        except BlockError as e:
+            if e.kind in ("ParentUnknown",):
+                self.sync.on_unknown_parent(origin, signed_block)
+                return IGNORE
+            if e.kind in ("FutureSlot", "BlockIsAlreadyKnown", "RepeatProposal"):
+                return IGNORE
+            return REJECT
+
+    def _import_gossip_block(self, signed_block) -> None:
+        self.chain.process_block(signed_block)
+        self.sync.on_block_imported(signed_block)
+
+    def _validate_attestation(self, topic: str, data: bytes, origin: str) -> str:
+        try:
+            att = self.chain.types.Attestation.deserialize(data)
+        except Exception:
+            return REJECT
+        if self.processor is not None:
+            self.processor.send(WorkEvent(
+                "gossip_attestation", att,
+                process_individual=lambda a: self._safe_att(a),
+                process_batch=lambda atts: self.chain.process_attestation_batch(atts),
+            ))
+            return ACCEPT
+        try:
+            self.chain.process_attestation(att)
+            return ACCEPT
+        except AttestationError as e:
+            if e.kind in ("PriorAttestationKnown", "PastSlot", "FutureSlot"):
+                return IGNORE
+            if e.kind == "UnknownHeadBlock":
+                return IGNORE
+            return REJECT
+
+    def _safe_att(self, att) -> None:
+        try:
+            self.chain.process_attestation(att)
+        except AttestationError:
+            pass
+
+    def _validate_aggregate(self, topic: str, data: bytes, origin: str) -> str:
+        try:
+            agg = self.chain.types.SignedAggregateAndProof.deserialize(data)
+        except Exception:
+            return REJECT
+        try:
+            if self.processor is not None:
+                self.processor.send(WorkEvent(
+                    "gossip_aggregate", agg,
+                    process_individual=lambda a: self._safe_agg(a),
+                ))
+                return ACCEPT
+            self.chain.process_aggregate(agg)
+            return ACCEPT
+        except AttestationError as e:
+            if e.kind in ("AttestationSupersetKnown", "AggregatorAlreadyKnown",
+                          "PastSlot", "FutureSlot", "UnknownHeadBlock"):
+                return IGNORE
+            return REJECT
+
+    def _safe_agg(self, agg) -> None:
+        try:
+            self.chain.process_aggregate(agg)
+        except AttestationError:
+            pass

@@ -1,0 +1,173 @@
+"""Network wire types: gossip topics, Req/Resp protocols, status/metadata.
+
+Mirror of lighthouse_network's type layer: topics (types/topics.rs:96-123 —
+fork-digest-scoped names incl. 64 attestation subnets + 4 sync subnets),
+`PubsubMessage` (types/pubsub.rs), Req/Resp protocol ids
+(rpc/protocol.rs:152-177), `Status` handshake and `MetaData`.
+
+Framing note: the reference compresses frames with snappy; this stack uses
+zlib (stdlib) behind the same length-prefixed shape — the seam
+(`encode_frame`/`decode_frame`) is where a snappy codec would slot in for
+mainnet interop.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from dataclasses import dataclass
+from typing import Optional
+
+ATTESTATION_SUBNET_COUNT = 64
+SYNC_COMMITTEE_SUBNET_COUNT = 4
+
+
+# --- topics (types/topics.rs) ----------------------------------------------
+
+
+def topic(name: str, fork_digest: bytes) -> str:
+    return f"/eth2/{fork_digest.hex()}/{name}/ssz_snappy"
+
+
+def beacon_block_topic(fork_digest: bytes) -> str:
+    return topic("beacon_block", fork_digest)
+
+
+def beacon_aggregate_and_proof_topic(fork_digest: bytes) -> str:
+    return topic("beacon_aggregate_and_proof", fork_digest)
+
+
+def attestation_subnet_topic(subnet_id: int, fork_digest: bytes) -> str:
+    return topic(f"beacon_attestation_{subnet_id}", fork_digest)
+
+
+def sync_committee_topic(subnet_id: int, fork_digest: bytes) -> str:
+    return topic(f"sync_committee_{subnet_id}", fork_digest)
+
+
+def voluntary_exit_topic(fork_digest: bytes) -> str:
+    return topic("voluntary_exit", fork_digest)
+
+
+def proposer_slashing_topic(fork_digest: bytes) -> str:
+    return topic("proposer_slashing", fork_digest)
+
+
+def attester_slashing_topic(fork_digest: bytes) -> str:
+    return topic("attester_slashing", fork_digest)
+
+
+def bls_to_execution_change_topic(fork_digest: bytes) -> str:
+    return topic("bls_to_execution_change", fork_digest)
+
+
+def compute_subnet_for_attestation(spec, slot: int, committee_index: int,
+                                   committees_per_slot: int) -> int:
+    """Spec compute_subnet_for_attestation."""
+    P = spec.preset
+    slots_since_epoch_start = slot % P.SLOTS_PER_EPOCH
+    committees_since_epoch_start = committees_per_slot * slots_since_epoch_start
+    return (committees_since_epoch_start + committee_index) % \
+        ATTESTATION_SUBNET_COUNT
+
+
+# --- Req/Resp protocols (rpc/protocol.rs:152-177) ---------------------------
+
+
+class Protocol:
+    STATUS = "/eth2/beacon_chain/req/status/1"
+    GOODBYE = "/eth2/beacon_chain/req/goodbye/1"
+    BLOCKS_BY_RANGE = "/eth2/beacon_chain/req/beacon_blocks_by_range/2"
+    BLOCKS_BY_ROOT = "/eth2/beacon_chain/req/beacon_blocks_by_root/2"
+    BLOBS_BY_RANGE = "/eth2/beacon_chain/req/blob_sidecars_by_range/1"
+    BLOBS_BY_ROOT = "/eth2/beacon_chain/req/blob_sidecars_by_root/1"
+    PING = "/eth2/beacon_chain/req/ping/1"
+    METADATA = "/eth2/beacon_chain/req/metadata/2"
+    LIGHT_CLIENT_BOOTSTRAP = "/eth2/beacon_chain/req/light_client_bootstrap/1"
+
+
+@dataclass
+class Status:
+    """The handshake (rpc/methods.rs StatusMessage)."""
+
+    fork_digest: bytes
+    finalized_root: bytes
+    finalized_epoch: int
+    head_root: bytes
+    head_slot: int
+
+    def to_bytes(self) -> bytes:
+        return self.fork_digest + self.finalized_root + \
+            struct.pack("<Q", self.finalized_epoch) + self.head_root + \
+            struct.pack("<Q", self.head_slot)
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "Status":
+        return cls(
+            fork_digest=b[0:4],
+            finalized_root=b[4:36],
+            finalized_epoch=struct.unpack("<Q", b[36:44])[0],
+            head_root=b[44:76],
+            head_slot=struct.unpack("<Q", b[76:84])[0],
+        )
+
+
+@dataclass
+class MetaData:
+    seq_number: int = 0
+    attnets: int = 0   # 64-bit subnet bitfield
+    syncnets: int = 0  # 4-bit
+
+
+@dataclass
+class BlocksByRangeRequest:
+    start_slot: int
+    count: int
+
+    def to_bytes(self) -> bytes:
+        return struct.pack("<QQ", self.start_slot, self.count)
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "BlocksByRangeRequest":
+        s, c = struct.unpack("<QQ", b[:16])
+        return cls(s, c)
+
+
+@dataclass
+class BlocksByRootRequest:
+    roots: list
+
+    def to_bytes(self) -> bytes:
+        return b"".join(self.roots)
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "BlocksByRootRequest":
+        return cls([b[i:i + 32] for i in range(0, len(b), 32)])
+
+
+# --- framing (rpc/codec/: length-prefix + compression) ----------------------
+
+
+def encode_frame(payload: bytes) -> bytes:
+    comp = zlib.compress(payload, 1)
+    return struct.pack("<I", len(comp)) + comp
+
+
+def decode_frame(data: bytes) -> tuple:
+    """-> (payload, bytes_consumed) or (None, 0) if incomplete."""
+    if len(data) < 4:
+        return None, 0
+    n = struct.unpack("<I", data[:4])[0]
+    if len(data) < 4 + n:
+        return None, 0
+    return zlib.decompress(data[4:4 + n]), 4 + n
+
+
+# --- goodbye / ban reasons --------------------------------------------------
+
+
+class GoodbyeReason:
+    CLIENT_SHUTDOWN = 1
+    IRRELEVANT_NETWORK = 2
+    FAULT_OR_ERROR = 3
+    BANNED = 251

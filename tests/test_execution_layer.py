@@ -1,0 +1,166 @@
+"""Execution layer: mock engine semantics, payload-status interpretation,
+JWT/JSON-RPC client against the in-process mock server, and the chain's
+optimistic-import behavior (reference: execution_layer tests +
+beacon_chain/tests/payload_invalidation.rs shape)."""
+
+import pytest
+
+from lighthouse_tpu.execution_layer import (
+    ExecutionLayer,
+    MockEngineServer,
+    MockExecutionEngine,
+    compute_block_hash,
+    make_jwt,
+)
+from lighthouse_tpu.execution_layer.engine_api import payload_to_json
+from lighthouse_tpu.types.containers import make_types
+from lighthouse_tpu.types.spec import minimal_spec
+
+
+@pytest.fixture(scope="module")
+def types():
+    return make_types(minimal_spec().preset)
+
+
+def _build_payload(types, engine, el):
+    out = engine.forkchoice_updated(
+        engine.genesis_hash, engine.genesis_hash, engine.genesis_hash,
+        {"timestamp": 1000, "prevRandao": b"\x01" * 32,
+         "suggestedFeeRecipient": b"\x02" * 20, "withdrawals": []},
+    )
+    return engine.get_payload(out["payloadId"])
+
+
+def test_mock_engine_build_and_import(types):
+    engine = MockExecutionEngine(types)
+    el = ExecutionLayer(engine, types=types)
+    payload = _build_payload(types, engine, el)
+    assert payload.block_number == 1
+    assert bytes(payload.block_hash) == compute_block_hash(
+        payload_to_json(payload)
+    )
+    assert el.notify_new_payload(payload) == "VALID"
+
+
+def test_mock_engine_rejects_bad_hash_and_unknown_parent(types):
+    engine = MockExecutionEngine(types)
+    el = ExecutionLayer(engine, types=types)
+    payload = _build_payload(types, engine, el)
+    bad = types.ExecutionPayloadCapella.deserialize(
+        types.ExecutionPayloadCapella.serialize(payload)
+    )
+    bad.block_hash = b"\xff" * 32
+    assert el.notify_new_payload(bad) == "INVALID"
+
+    orphan = types.ExecutionPayloadCapella.deserialize(
+        types.ExecutionPayloadCapella.serialize(payload)
+    )
+    orphan.parent_hash = b"\xee" * 32
+    assert el.notify_new_payload(orphan) == "SYNCING"
+
+
+def test_hook_forces_statuses(types):
+    engine = MockExecutionEngine(types)
+    el = ExecutionLayer(engine, types=types)
+    payload = _build_payload(types, engine, el)
+    engine.on_new_payload = lambda p: "SYNCING"
+    assert el.notify_new_payload(payload) == "SYNCING"
+    engine.on_new_payload = lambda p: "INVALID"
+    assert el.notify_new_payload(payload) == "INVALID"
+
+
+def test_jwt_shape():
+    token = make_jwt(b"\x11" * 32, issued_at=1700000000)
+    parts = token.split(".")
+    assert len(parts) == 3
+    import base64, json
+
+    claims = json.loads(base64.urlsafe_b64decode(parts[1] + "=="))
+    assert claims == {"iat": 1700000000}
+
+
+def test_http_engine_roundtrip(types):
+    """Full client path: ExecutionLayer.http -> JSON-RPC -> mock server."""
+    engine = MockExecutionEngine(types)
+    server = MockEngineServer(engine).start()
+    try:
+        el = ExecutionLayer.http(server.url, b"\x22" * 32, types)
+        payload = el.get_payload(
+            parent_hash=engine.genesis_hash, timestamp=1234,
+            prev_randao=b"\x03" * 32, withdrawals=[],
+        )
+        assert payload.block_number == 1
+        assert el.notify_new_payload(payload) == "VALID"
+        out = el.notify_forkchoice_updated(
+            bytes(payload.block_hash), bytes(payload.block_hash),
+            engine.genesis_hash,
+        )
+        assert out["payloadStatus"]["status"] == "VALID"
+    finally:
+        server.stop()
+
+
+def test_offline_engine_is_optimistic(types):
+    el = ExecutionLayer.http("http://127.0.0.1:1", b"\x00" * 32, types)
+    payload = types.ExecutionPayloadCapella()
+    assert el.notify_new_payload(payload) == "SYNCING"
+    assert el.engine_online is False
+
+
+def test_chain_imports_optimistically_with_mock_el(types):
+    """BeaconChain + mock EL: payload validated on import; forced SYNCING
+    still imports (optimistic sync), forced INVALID rejects."""
+    from lighthouse_tpu.beacon_chain import BlockError
+    from lighthouse_tpu.testing.harness import BeaconChainHarness
+
+    engine = None
+
+    def make_harness():
+        nonlocal engine
+        h = BeaconChainHarness(n_validators=64)
+        engine = MockExecutionEngine(
+            h.types,
+            terminal_block_hash=bytes(
+                h.chain.head.state.latest_execution_payload_header.block_hash
+            ),
+        )
+        h.chain.execution_layer = ExecutionLayer(engine, types=h.types)
+        return h
+
+    h = make_harness()
+    # harness blocks use the sha256 mock hash scheme only accidentally;
+    # rebuild the payload hash properly for the EL
+    h.advance_slot()
+    slot = h.current_slot
+    signed, root = h.make_block(slot=slot)
+    # recompute the payload hash the way the mock engine expects
+    payload = signed.message.body.execution_payload
+    payload.block_hash = compute_block_hash(payload_to_json(payload))
+    # state_root depends on the payload; rebuild via harness internals
+    from lighthouse_tpu.state_transition import block_processing as bp
+    from lighthouse_tpu.state_transition import slot_processing as sp
+
+    state = h.chain.state_for_block_import(bytes(signed.message.parent_root))
+    sp.process_slots(state, h.types, h.spec, slot, fork="capella")
+    unsigned = h.types.SignedBeaconBlock["capella"](
+        message=signed.message, signature=b"\x00" * 96
+    )
+    bp.per_block_processing(
+        state, h.types, h.spec, unsigned, "capella",
+        verify_signatures=bp.VerifySignatures.FALSE,
+    )
+    signed.message.state_root = h.types.BeaconState["capella"].hash_tree_root(state)
+    signed = h.sign_block(
+        h.chain.head_state_for_signatures(), signed.message, "capella"
+    )
+    h.chain.process_block(signed)
+    assert engine.head_hash == engine.genesis_hash  # fcU not yet driven
+
+    # forced INVALID refuses import
+    h2 = make_harness()
+    h2.advance_slot()
+    engine.on_new_payload = lambda p: "INVALID"
+    signed2, _ = h2.make_block(slot=h2.current_slot)
+    with pytest.raises(BlockError) as ei:
+        h2.chain.process_block(signed2)
+    assert ei.value.kind == "ExecutionPayloadInvalid"
