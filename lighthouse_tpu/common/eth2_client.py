@@ -1,0 +1,109 @@
+"""Typed Beacon-API client (reference: common/eth2 BeaconNodeHttpClient,
+src/lib.rs:158) — the ONLY channel between the validator stack and a beacon
+node (a real process boundary in the reference; an HTTP boundary here too).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+
+class Eth2ClientError(Exception):
+    def __init__(self, status: int, message: str):
+        self.status = status
+        super().__init__(f"HTTP {status}: {message}")
+
+
+class BeaconNodeHttpClient:
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _get(self, path: str, params: Optional[Dict[str, str]] = None) -> Any:
+        url = self.base_url + path
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        return self._do(urllib.request.Request(url))
+
+    def _post(self, path: str, body: Any) -> Any:
+        data = json.dumps(body).encode()
+        req = urllib.request.Request(
+            self.base_url + path, data=data,
+            headers={"Content-Type": "application/json"},
+        )
+        return self._do(req)
+
+    def _do(self, req) -> Any:
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            raise Eth2ClientError(e.code, e.read().decode("utf-8", "replace"))
+
+    # ------------------------------------------------------------- endpoints
+
+    def get_node_version(self) -> str:
+        return self._get("/eth/v1/node/version")["data"]["version"]
+
+    def get_syncing(self) -> Dict[str, Any]:
+        return self._get("/eth/v1/node/syncing")["data"]
+
+    def get_genesis(self) -> Dict[str, Any]:
+        return self._get("/eth/v1/beacon/genesis")["data"]
+
+    def get_state_root(self, state_id: str = "head") -> bytes:
+        out = self._get(f"/eth/v1/beacon/states/{state_id}/root")
+        return bytes.fromhex(out["data"]["root"][2:])
+
+    def get_finality_checkpoints(self, state_id: str = "head") -> Dict[str, Any]:
+        return self._get(
+            f"/eth/v1/beacon/states/{state_id}/finality_checkpoints"
+        )["data"]
+
+    def get_validator(self, index: int, state_id: str = "head") -> Dict[str, Any]:
+        return self._get(
+            f"/eth/v1/beacon/states/{state_id}/validators/{index}"
+        )["data"]
+
+    def get_block(self, block_id: str = "head") -> Dict[str, Any]:
+        return self._get(f"/eth/v2/beacon/blocks/{block_id}")
+
+    def publish_block(self, signed_block_json: Dict[str, Any]) -> None:
+        self._post("/eth/v1/beacon/blocks", signed_block_json)
+
+    def get_proposer_duties(self, epoch: int) -> List[Dict[str, Any]]:
+        return self._get(f"/eth/v1/validator/duties/proposer/{epoch}")["data"]
+
+    def post_attester_duties(self, epoch: int,
+                             indices: List[int]) -> List[Dict[str, Any]]:
+        return self._post(
+            f"/eth/v1/validator/duties/attester/{epoch}",
+            [str(i) for i in indices],
+        )["data"]
+
+    def get_attestation_data(self, slot: int, committee_index: int) -> Dict[str, Any]:
+        return self._get("/eth/v1/validator/attestation_data", {
+            "slot": str(slot), "committee_index": str(committee_index),
+        })["data"]
+
+    def get_block_proposal(self, slot: int, randao_reveal: bytes,
+                           graffiti: bytes = b"\x00" * 32) -> Dict[str, Any]:
+        return self._get(f"/eth/v2/validator/blocks/{slot}", {
+            "randao_reveal": "0x" + randao_reveal.hex(),
+            "graffiti": "0x" + graffiti.hex(),
+        })
+
+    def submit_attestations(self, atts_json: List[Dict[str, Any]]) -> None:
+        self._post("/eth/v1/beacon/pool/attestations", atts_json)
+
+    def submit_aggregates(self, aggs_json: List[Dict[str, Any]]) -> None:
+        self._post("/eth/v1/validator/aggregate_and_proofs", aggs_json)
+
+    def get_aggregate(self, slot: int, data_root: bytes) -> Dict[str, Any]:
+        return self._get("/eth/v1/validator/aggregate_attestation", {
+            "slot": str(slot),
+            "attestation_data_root": "0x" + data_root.hex(),
+        })["data"]

@@ -1,0 +1,6 @@
+"""Beacon HTTP API (reference: beacon_node/http_api, L9)."""
+
+from .json_codec import from_json, to_json
+from .server import ApiError, BeaconApiServer, EventBus
+
+__all__ = ["ApiError", "BeaconApiServer", "EventBus", "from_json", "to_json"]

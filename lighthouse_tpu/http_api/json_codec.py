@@ -1,0 +1,53 @@
+"""Beacon-API JSON codec: SSZ containers <-> spec JSON.
+
+The Beacon API represents uint64 as decimal strings, byte vectors as
+0x-hex, bitfields as the SSZ-serialized hex, and containers as snake_case
+objects (the reference derives this via serde in consensus/types; here it
+is driven reflectively off the `_ssz_fields` descriptors).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from lighthouse_tpu.types import ssz
+
+
+def to_json(typ, value) -> Any:
+    if isinstance(typ, type) and issubclass(typ, ssz.Container):
+        return {
+            name: to_json(ftyp, getattr(value, name))
+            for name, ftyp in typ._ssz_fields
+        }
+    if isinstance(typ, ssz._Uint):
+        return str(int(value))
+    if isinstance(typ, ssz._Boolean):
+        return bool(value)
+    if isinstance(typ, (ssz._ByteVector, ssz.ByteList)):
+        return "0x" + bytes(value).hex()
+    if isinstance(typ, (ssz.Bitvector, ssz.Bitlist)):
+        return "0x" + typ.serialize(value).hex()
+    if isinstance(typ, (ssz.Vector, ssz.List)):
+        return [to_json(typ.elem, v) for v in value]
+    raise TypeError(f"unsupported type {typ}")
+
+
+def from_json(typ, obj: Any):
+    if isinstance(typ, type) and issubclass(typ, ssz.Container):
+        kwargs = {}
+        for name, ftyp in typ._ssz_fields:
+            if name in obj:
+                kwargs[name] = from_json(ftyp, obj[name])
+        return typ(**kwargs)
+    if isinstance(typ, ssz._Uint):
+        return int(obj)
+    if isinstance(typ, ssz._Boolean):
+        return bool(obj)
+    if isinstance(typ, (ssz._ByteVector, ssz.ByteList)):
+        return bytes.fromhex(obj[2:] if obj.startswith("0x") else obj)
+    if isinstance(typ, (ssz.Bitvector, ssz.Bitlist)):
+        raw = bytes.fromhex(obj[2:] if obj.startswith("0x") else obj)
+        return typ.deserialize(raw)
+    if isinstance(typ, (ssz.Vector, ssz.List)):
+        return [from_json(typ.elem, v) for v in obj]
+    raise TypeError(f"unsupported type {typ}")

@@ -1,0 +1,427 @@
+"""Beacon API HTTP server.
+
+Mirror of beacon_node/http_api (lib.rs:252-279 route table; warp there,
+stdlib ThreadingHTTPServer here). Implements the routes the validator stack
+and tooling depend on: node status, genesis, state/finality queries, block
+fetch/publish, validator duties, attestation production, block production,
+pool submission (which drives the batch verification path), and an SSE
+event stream (events.rs analog).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
+
+from lighthouse_tpu.beacon_chain import AttestationError, BlockError
+from lighthouse_tpu.state_transition import helpers as h
+
+from .json_codec import from_json, to_json
+
+VERSION = "lighthouse-tpu/0.1.0"
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        self.status = status
+        super().__init__(message)
+        self.message = message
+
+
+class EventBus:
+    """SSE fan-out (beacon_chain/src/events.rs ServerSentEventHandler)."""
+
+    def __init__(self):
+        self._subscribers: List[queue.Queue] = []
+        self._lock = threading.Lock()
+
+    def subscribe(self) -> queue.Queue:
+        q = queue.Queue(maxsize=256)
+        with self._lock:
+            self._subscribers.append(q)
+        return q
+
+    def publish(self, event: str, data: Dict[str, Any]) -> None:
+        with self._lock:
+            subs = list(self._subscribers)
+        for q in subs:
+            try:
+                q.put_nowait((event, data))
+            except queue.Full:
+                pass
+
+
+class BeaconApiServer:
+    def __init__(self, chain, network=None, port: int = 0):
+        self.chain = chain
+        self.network = network
+        self.events = EventBus()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, status: int, body: Dict[str, Any]) -> None:
+                data = json.dumps(body).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _run(self, method: str) -> None:
+                parsed = urlparse(self.path)
+                try:
+                    length = int(self.headers.get("Content-Length", 0) or 0)
+                    body = json.loads(self.rfile.read(length)) if length else None
+                    if parsed.path == "/eth/v1/events":
+                        outer._serve_events(self)
+                        return
+                    result = outer.dispatch(
+                        method, parsed.path, parse_qs(parsed.query), body
+                    )
+                    self._reply(200, result)
+                except ApiError as e:
+                    self._reply(e.status, {"code": e.status, "message": e.message})
+                except Exception as e:  # pragma: no cover
+                    self._reply(500, {"code": 500, "message": repr(e)})
+
+            def do_GET(self):
+                self._run("GET")
+
+            def do_POST(self):
+                self._run("POST")
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self.server.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+
+    def start(self) -> "BeaconApiServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+
+    # ----------------------------------------------------------------- SSE
+
+    def _serve_events(self, handler) -> None:
+        q = self.events.subscribe()
+        handler.send_response(200)
+        handler.send_header("Content-Type", "text/event-stream")
+        handler.send_header("Cache-Control", "no-cache")
+        handler.end_headers()
+        try:
+            while True:
+                event, data = q.get(timeout=30)
+                payload = f"event: {event}\ndata: {json.dumps(data)}\n\n"
+                handler.wfile.write(payload.encode())
+                handler.wfile.flush()
+        except Exception:
+            return
+
+    # ------------------------------------------------------------- dispatch
+
+    def dispatch(self, method: str, path: str, query: Dict[str, List[str]],
+                 body) -> Dict[str, Any]:
+        chain = self.chain
+        t, spec = chain.types, chain.spec
+
+        if path == "/eth/v1/node/version":
+            return {"data": {"version": VERSION}}
+        if path == "/eth/v1/node/health":
+            return {}
+        if path == "/eth/v1/node/syncing":
+            head_slot = chain.head.state.slot
+            current = chain.current_slot()
+            return {"data": {
+                "head_slot": str(head_slot),
+                "sync_distance": str(max(0, current - head_slot)),
+                "is_syncing": current > head_slot + 1,
+                "is_optimistic": False,
+                "el_offline": bool(
+                    chain.execution_layer is not None
+                    and not chain.execution_layer.engine_online
+                ),
+            }}
+
+        if path == "/eth/v1/beacon/genesis":
+            state = chain.head.state
+            return {"data": {
+                "genesis_time": str(state.genesis_time),
+                "genesis_validators_root":
+                    "0x" + bytes(state.genesis_validators_root).hex(),
+                "genesis_fork_version":
+                    "0x" + spec.genesis_fork_version.hex(),
+            }}
+
+        m = re.fullmatch(r"/eth/v1/beacon/states/([^/]+)/root", path)
+        if m:
+            state = self._state_by_id(m.group(1))
+            fork = chain.fork_at(state.slot)
+            root = t.BeaconState[fork].hash_tree_root(state)
+            return {"data": {"root": "0x" + root.hex()}}
+
+        m = re.fullmatch(r"/eth/v1/beacon/states/([^/]+)/finality_checkpoints", path)
+        if m:
+            state = self._state_by_id(m.group(1))
+            cp = lambda c: {"epoch": str(c.epoch), "root": "0x" + bytes(c.root).hex()}
+            return {"data": {
+                "previous_justified": cp(state.previous_justified_checkpoint),
+                "current_justified": cp(state.current_justified_checkpoint),
+                "finalized": cp(state.finalized_checkpoint),
+            }}
+
+        m = re.fullmatch(r"/eth/v1/beacon/states/([^/]+)/validators/(\w+)", path)
+        if m:
+            state = self._state_by_id(m.group(1))
+            idx = self._validator_index(state, m.group(2))
+            v = state.validators[idx]
+            return {"data": {
+                "index": str(idx),
+                "balance": str(state.balances[idx]),
+                "status": self._validator_status(v, h.get_current_epoch(state, spec)),
+                "validator": to_json(chain.types.Validator, v),
+            }}
+
+        m = re.fullmatch(r"/eth/v2/beacon/blocks/([^/]+)", path)
+        if m:
+            signed = self._block_by_id(m.group(1))
+            fork = chain.fork_at(signed.message.slot)
+            return {
+                "version": fork,
+                "data": to_json(t.SignedBeaconBlock[fork], signed),
+            }
+
+        if path == "/eth/v1/beacon/blocks" and method == "POST":
+            return self._publish_block(body)
+
+        m = re.fullmatch(r"/eth/v1/validator/duties/proposer/(\d+)", path)
+        if m:
+            return self._proposer_duties(int(m.group(1)))
+
+        m = re.fullmatch(r"/eth/v1/validator/duties/attester/(\d+)", path)
+        if m and method == "POST":
+            return self._attester_duties(int(m.group(1)), [int(i) for i in body])
+
+        if path == "/eth/v1/validator/attestation_data":
+            slot = int(query["slot"][0])
+            index = int(query["committee_index"][0])
+            data = chain.produce_unaggregated_attestation(slot, index)
+            return {"data": to_json(t.AttestationData, data)}
+
+        m = re.fullmatch(r"/eth/v3/validator/blocks/(\d+)", path) or \
+            re.fullmatch(r"/eth/v2/validator/blocks/(\d+)", path)
+        if m:
+            slot = int(m.group(1))
+            reveal = bytes.fromhex(query["randao_reveal"][0][2:])
+            graffiti = b"\x00" * 32
+            if "graffiti" in query:
+                graffiti = bytes.fromhex(query["graffiti"][0][2:])
+            block, _post = chain.produce_block(slot, reveal, graffiti)
+            fork = chain.fork_at(slot)
+            return {"version": fork,
+                    "data": to_json(t.BeaconBlock[fork], block)}
+
+        if path == "/eth/v1/beacon/pool/attestations" and method == "POST":
+            return self._submit_attestations(body)
+
+        if path == "/eth/v1/validator/aggregate_and_proofs" and method == "POST":
+            return self._submit_aggregates(body)
+
+        if path == "/eth/v1/validator/aggregate_attestation":
+            slot = int(query["slot"][0])
+            root = bytes.fromhex(query["attestation_data_root"][0][2:])
+            agg = self._best_aggregate(slot, root)
+            if agg is None:
+                raise ApiError(404, "no matching aggregate found")
+            return {"data": to_json(t.Attestation, agg)}
+
+        if path == "/eth/v1/validator/beacon_committee_subscriptions" and \
+                method == "POST":
+            return {}
+
+        raise ApiError(404, f"unknown route {method} {path}")
+
+    # -------------------------------------------------------------- helpers
+
+    def _state_by_id(self, state_id: str):
+        chain = self.chain
+        if state_id == "head":
+            return chain.head.state
+        if state_id == "genesis":
+            root = chain.store.get_genesis_block_root()
+            state = chain.store.get_state(
+                chain._state_root_by_block.get(root, b"")
+            )
+            if state is None:
+                raise ApiError(404, "genesis state unavailable")
+            return state
+        if state_id == "finalized":
+            root = chain.fork_choice.finalized.root
+            sr = chain._state_root_by_block.get(root)
+            state = chain.store.get_state(sr) if sr else None
+            if state is None:
+                raise ApiError(404, "finalized state unavailable")
+            return state
+        if state_id.startswith("0x"):
+            state = chain.store.get_state(bytes.fromhex(state_id[2:]))
+            if state is None:
+                raise ApiError(404, "state not found")
+            return state
+        raise ApiError(400, f"unsupported state id {state_id}")
+
+    def _block_by_id(self, block_id: str):
+        chain = self.chain
+        if block_id == "head":
+            block = chain.store.get_block(chain.head.block_root)
+        elif block_id.startswith("0x"):
+            block = chain.store.get_block(bytes.fromhex(block_id[2:]))
+        else:
+            raise ApiError(400, f"unsupported block id {block_id}")
+        if block is None:
+            raise ApiError(404, "block not found")
+        return block
+
+    def _validator_index(self, state, vid: str) -> int:
+        if vid.isdigit():
+            idx = int(vid)
+            if idx >= len(state.validators):
+                raise ApiError(404, "validator not found")
+            return idx
+        raise ApiError(400, "pubkey lookup unsupported; use an index")
+
+    @staticmethod
+    def _validator_status(v, epoch: int) -> str:
+        if v.activation_epoch > epoch:
+            return "pending_queued"
+        if v.exit_epoch <= epoch:
+            return "exited_slashed" if v.slashed else "exited_unslashed"
+        return "active_slashed" if v.slashed else "active_ongoing"
+
+    # --------------------------------------------------------------- routes
+
+    def _publish_block(self, body) -> Dict[str, Any]:
+        chain = self.chain
+        t = chain.types
+        slot = int(body["message"]["slot"])
+        fork = chain.fork_at(slot)
+        signed = from_json(t.SignedBeaconBlock[fork], body)
+        try:
+            root = chain.process_block(signed)
+        except BlockError as e:
+            raise ApiError(400, f"block rejected: {e}")
+        self.events.publish("block", {
+            "slot": str(slot), "block": "0x" + root.hex(),
+        })
+        self.events.publish("head", {
+            "slot": str(slot), "block": "0x" + chain.head.block_root.hex(),
+        })
+        if self.network is not None:
+            self.network.publish_block(signed)
+        return {}
+
+    def _proposer_duties(self, epoch: int) -> Dict[str, Any]:
+        chain = self.chain
+        spec = chain.spec
+        start = spec.start_slot_of_epoch(epoch)
+        state = chain.head_state_clone_at(start)
+        proposers = chain.proposer_cache.get_or_compute(state, spec, epoch)
+        duties = []
+        for i, proposer in enumerate(proposers):
+            pk = chain.pubkey_cache.get(proposer)
+            duties.append({
+                "pubkey": "0x" + pk.to_bytes().hex() if pk else "0x",
+                "validator_index": str(proposer),
+                "slot": str(start + i),
+            })
+        return {"data": duties,
+                "dependent_root": "0x" + chain.head.block_root.hex()}
+
+    def _attester_duties(self, epoch: int, indices: List[int]) -> Dict[str, Any]:
+        chain = self.chain
+        spec = chain.spec
+        start = spec.start_slot_of_epoch(epoch)
+        state = chain.head_state_clone_at(start)
+        cache = chain.shuffling_cache.get_or_compute(state, spec, epoch)
+        wanted = set(indices)
+        duties = []
+        for slot in range(start, start + spec.preset.SLOTS_PER_EPOCH):
+            for index in range(cache.committees_per_slot):
+                committee = cache.committee(slot, index)
+                for pos, v in enumerate(committee):
+                    if v in wanted:
+                        pk = chain.pubkey_cache.get(v)
+                        duties.append({
+                            "pubkey": "0x" + pk.to_bytes().hex() if pk else "0x",
+                            "validator_index": str(v),
+                            "committee_index": str(index),
+                            "committee_length": str(len(committee)),
+                            "committees_at_slot": str(cache.committees_per_slot),
+                            "validator_committee_index": str(pos),
+                            "slot": str(slot),
+                        })
+        return {"data": duties,
+                "dependent_root": "0x" + chain.head.block_root.hex()}
+
+    def _submit_attestations(self, body) -> Dict[str, Any]:
+        """Pool submission — the batch-verify choke point (§3.2: http_api
+        pool endpoints call batch_verify_*)."""
+        chain = self.chain
+        t = chain.types
+        atts = [from_json(t.Attestation, a) for a in body]
+        results = chain.process_attestation_batch(atts)
+        failures = []
+        for i, r in enumerate(results):
+            if isinstance(r, AttestationError):
+                if r.kind == "PriorAttestationKnown":
+                    continue  # duplicate: not an error per API semantics
+                failures.append({"index": i, "message": str(r)})
+            else:
+                self.events.publish("attestation", {"index": str(i)})
+                if self.network is not None:
+                    self.network.publish_attestation(atts[i])
+        if failures:
+            raise ApiError(400, json.dumps(failures))
+        return {}
+
+    def _submit_aggregates(self, body) -> Dict[str, Any]:
+        chain = self.chain
+        t = chain.types
+        aggs = [from_json(t.SignedAggregateAndProof, a) for a in body]
+        failures = []
+        for i, agg in enumerate(aggs):
+            try:
+                chain.process_aggregate(agg)
+                if self.network is not None:
+                    self.network.publish_aggregate(agg)
+            except AttestationError as e:
+                if e.kind not in ("AttestationSupersetKnown",
+                                  "AggregatorAlreadyKnown"):
+                    failures.append({"index": i, "message": str(e)})
+        if failures:
+            raise ApiError(400, json.dumps(failures))
+        return {}
+
+    def _best_aggregate(self, slot: int, data_root: bytes):
+        chain = self.chain
+        if chain.op_pool is None:
+            return None
+        groups = chain.op_pool._attestations.get(bytes(data_root), [])
+        best = None
+        for bits, att in groups:
+            if att.data.slot != slot:
+                continue
+            if best is None or sum(bits) > sum(1 for b in best.aggregation_bits if b):
+                best = att
+        return best

@@ -1,0 +1,5 @@
+"""Slasher (reference: slasher/ + slasher/service, SURVEY.md §2.5)."""
+
+from .slasher import AttesterSlashingStatus, Slasher, SlasherService
+
+__all__ = ["AttesterSlashingStatus", "Slasher", "SlasherService"]

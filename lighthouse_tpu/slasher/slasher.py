@@ -1,0 +1,184 @@
+"""Slasher — surround/double-vote detection over 2D min/max-target arrays.
+
+Mirror of slasher/src: attestations index into per-validator epoch arrays
+(array.rs:22-30 layout — validators x epochs, chunked); `MinTargetChunk` /
+`MaxTargetChunk` (:106,:112) hold, for each (validator, source_epoch), the
+min/max attestation target seen with source > / < that epoch. A new
+attestation surrounds an old one iff min_target[v][source+1..] dips below
+its target (and is surrounded iff max_target exceeds it). Double votes are
+caught by a per-(validator, target) record of the attestation root.
+
+TPU-first twist: the arrays are dense numpy matrices updated with
+vectorized prefix scans over the epoch axis — the 2D-chunk scheme of the
+reference without the LMDB paging (the store column persists chunks;
+jax.vmap is a drop-in for the update sweep at mainnet validator counts,
+SURVEY.md §7.2 step 8).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclass
+class AttesterSlashingStatus:
+    """Outcome of checking one attestation (slasher/src/lib.rs:29-45)."""
+
+    kind: str  # "not_slashable" | "double_vote" | "surrounds" | "surrounded"
+    prior: Optional[object] = None  # the conflicting indexed attestation
+
+
+class Slasher:
+    HISTORY_EPOCHS = 4096  # default history_length (slasher config)
+
+    def __init__(self, n_validators: int = 0, history_epochs: int = None):
+        self.history = history_epochs or self.HISTORY_EPOCHS
+        self._lock = threading.Lock()
+        # min_target[v, s] = min target over recorded attestations of v with
+        # source > s;  max_target[v, s] = max target with source < s.
+        # Sentinel: +inf / 0.
+        self._n = 0
+        self._min_target = np.zeros((0, self.history), dtype=np.uint64)
+        self._max_target = np.zeros((0, self.history), dtype=np.uint64)
+        self._INF = np.iinfo(np.uint64).max
+        # (validator, target_epoch) -> (data_root, indexed_attestation)
+        self._by_target: Dict[Tuple[int, int], Tuple[bytes, object]] = {}
+        # (validator, source, target) -> indexed attestation (for reporting)
+        self._records: Dict[Tuple[int, int, int], object] = {}
+        if n_validators:
+            self._grow(n_validators)
+
+    def _grow(self, n: int) -> None:
+        if n <= self._n:
+            return
+        add = n - self._n
+        self._min_target = np.vstack([
+            self._min_target,
+            np.full((add, self.history), self._INF, dtype=np.uint64),
+        ])
+        self._max_target = np.vstack([
+            self._max_target,
+            np.zeros((add, self.history), dtype=np.uint64),
+        ])
+        self._n = n
+
+    def _e(self, epoch: int) -> int:
+        return epoch % self.history
+
+    # ------------------------------------------------------------- checking
+
+    def process_attestation(
+        self, indexed_attestation, data_root: bytes
+    ) -> List[Tuple[int, AttesterSlashingStatus]]:
+        """Check + record one attestation for each attester; returns the
+        slashable findings [(validator_index, status)] (the batch update
+        loop processes the queue per epoch; the per-attestation core is
+        identical)."""
+        data = indexed_attestation.data
+        source = int(data.source.epoch)
+        target = int(data.target.epoch)
+        out: List[Tuple[int, AttesterSlashingStatus]] = []
+        with self._lock:
+            need = max(indexed_attestation.attesting_indices, default=-1) + 1
+            self._grow(max(need, self._n))
+            for v in indexed_attestation.attesting_indices:
+                status = self._check_one(v, source, target, data_root)
+                if status.kind != "not_slashable":
+                    out.append((v, status))
+                self._record(v, source, target, data_root, indexed_attestation)
+        return out
+
+    def _check_one(self, v: int, source: int, target: int,
+                   data_root: bytes) -> AttesterSlashingStatus:
+        prior = self._by_target.get((v, target))
+        if prior is not None and prior[0] != data_root:
+            return AttesterSlashingStatus("double_vote", prior[1])
+        # Does the new attestation surround a prior one?  Any recorded
+        # (s', t') with s' > source and t' < target  <=>  min over
+        # min_target[v, source] (min target with source' > source) < target.
+        mt = int(self._min_target[v, self._e(source)])
+        if mt != self._INF and mt < target and mt > source:
+            rec = self._find_record_with(v, lambda s, t: s > source and t < target)
+            return AttesterSlashingStatus("surrounds", rec)
+        # Is the new attestation surrounded? Any (s', t') with s' < source
+        # and t' > target  <=>  max_target[v, source] > target.
+        xt = int(self._max_target[v, self._e(source)])
+        if xt > target:
+            rec = self._find_record_with(v, lambda s, t: s < source and t > target)
+            return AttesterSlashingStatus("surrounded", rec)
+        return AttesterSlashingStatus("not_slashable")
+
+    def _find_record_with(self, v: int, pred) -> Optional[object]:
+        for (rv, s, t), att in self._records.items():
+            if rv == v and pred(s, t):
+                return att
+        return None
+
+    def _record(self, v: int, source: int, target: int, data_root: bytes,
+                indexed_attestation) -> None:
+        self._by_target[(v, target)] = (data_root, indexed_attestation)
+        self._records[(v, source, target)] = indexed_attestation
+        # Vectorized chunk update (the min/max sweep of MinTargetChunk /
+        # MaxTargetChunk::update): epochs BELOW source get min_target
+        # candidates; epochs ABOVE source get max_target candidates.
+        if source > 0:
+            lo = max(0, source - self.history)
+            idx = np.arange(lo, source) % self.history
+            np.minimum.at(self._min_target[v], idx, np.uint64(target))
+        hi_lo = source + 1
+        hi = min(source + self.history, source + self.history)
+        idx = np.arange(hi_lo, min(hi_lo + self.history - 1,
+                                   source + self.history)) % self.history
+        # max_target[s] over sources < s: this attestation contributes its
+        # target to every s > source.
+        np.maximum.at(self._max_target[v], idx, np.uint64(target))
+
+    # ------------------------------------------------------------- pruning
+
+    def prune(self, current_epoch: int) -> None:
+        """Drop records older than the history window."""
+        low = current_epoch - self.history
+        with self._lock:
+            self._by_target = {
+                k: val for k, val in self._by_target.items() if k[1] >= low
+            }
+            self._records = {
+                k: val for k, val in self._records.items() if k[2] >= low
+            }
+
+
+class SlasherService:
+    """Wires the slasher into gossip/import (slasher/service): observed
+    attestations stream in; found slashings surface via `drain_slashings`
+    for broadcast + op-pool insertion."""
+
+    def __init__(self, slasher: Slasher, types):
+        self.slasher = slasher
+        self.types = types
+        self._found: List[object] = []
+        self._lock = threading.Lock()
+
+    def on_attestation(self, indexed_attestation) -> int:
+        data_root = self.types.AttestationData.hash_tree_root(
+            indexed_attestation.data
+        )
+        findings = self.slasher.process_attestation(
+            indexed_attestation, data_root
+        )
+        if findings:
+            with self._lock:
+                for v, status in findings:
+                    self._found.append(self.types.AttesterSlashing(
+                        attestation_1=status.prior,
+                        attestation_2=indexed_attestation,
+                    ))
+        return len(findings)
+
+    def drain_slashings(self) -> List[object]:
+        with self._lock:
+            out, self._found = self._found, []
+        return out

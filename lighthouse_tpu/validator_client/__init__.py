@@ -1,0 +1,15 @@
+"""Validator stack (reference: validator_client/, L11)."""
+
+from .client import BeaconNodeFallback, ValidatorClient
+from .slashing_protection import NotSafe, SlashingDatabase, SlashingProtectionError
+from .validator_store import LocalKeystoreSigner, ValidatorStore
+
+__all__ = [
+    "BeaconNodeFallback",
+    "LocalKeystoreSigner",
+    "NotSafe",
+    "SlashingDatabase",
+    "SlashingProtectionError",
+    "ValidatorClient",
+    "ValidatorStore",
+]

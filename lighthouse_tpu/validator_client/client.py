@@ -1,0 +1,239 @@
+"""ValidatorClient — duty-driven signer daemon.
+
+Mirror of validator_client/src: `DutiesService` polls proposer + attester
+duties per epoch over the Beacon API (duties_service.rs:348,468,572,1146);
+`AttestationService` produces/signs/publishes attestations at slot+1/3 and
+aggregates at slot+2/3 (attestation_service.rs:176,321,488); `BlockService`
+proposes when a proposer duty lands. `BeaconNodeFallback` ranks multiple
+BNs and fails over (beacon_node_fallback.rs). Doppelganger protection
+refuses to sign until the listen window passes (doppelganger_service.rs).
+
+Deterministic driving: `run_slot(slot)` executes one slot's duties; the
+threaded mode ticks off the slot clock the same way.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Callable, Dict, List, Optional
+
+from lighthouse_tpu.common.eth2_client import BeaconNodeHttpClient, Eth2ClientError
+from lighthouse_tpu.http_api.json_codec import from_json, to_json
+
+from .slashing_protection import NotSafe
+from .validator_store import ValidatorStore
+
+
+class BeaconNodeFallback:
+    """Ranked multi-BN redundancy: first healthy node serves each call."""
+
+    def __init__(self, clients: List[BeaconNodeHttpClient]):
+        self.clients = list(clients)
+
+    def call(self, fn: Callable[[BeaconNodeHttpClient], object]):
+        last_err: Optional[Exception] = None
+        for client in self.clients:
+            try:
+                return fn(client)
+            except Exception as e:
+                last_err = e
+        raise last_err if last_err else RuntimeError("no beacon nodes")
+
+
+class ValidatorClient:
+    def __init__(
+        self,
+        store: ValidatorStore,
+        beacon_nodes: BeaconNodeFallback,
+        types,
+        spec,
+        doppelganger_epochs: int = 0,
+    ):
+        self.store = store
+        self.bn = beacon_nodes
+        self.types = types
+        self.spec = spec
+        self.doppelganger_epochs = doppelganger_epochs
+        self._started_epoch: Optional[int] = None
+        self.attester_duties: Dict[int, List[dict]] = {}   # epoch -> duties
+        self.proposer_duties: Dict[int, List[dict]] = {}
+        self._fork_info: Optional[dict] = None
+        # produced attestations awaiting aggregation: slot -> list of dicts
+        self._own_attestations: Dict[int, List[dict]] = {}
+
+    # ----------------------------------------------------------------- init
+
+    def _ensure_fork_info(self) -> dict:
+        if self._fork_info is None:
+            genesis = self.bn.call(lambda c: c.get_genesis())
+            self._fork_info = {
+                "current_version": self.spec.fork_version_for_name("capella"),
+                "previous_version": self.spec.fork_version_for_name("capella"),
+                "epoch": 0,
+                "genesis_validators_root": bytes.fromhex(
+                    genesis["genesis_validators_root"][2:]
+                ),
+            }
+        return self._fork_info
+
+    def doppelganger_safe(self, epoch: int) -> bool:
+        """Refuse signing for the first N epochs after start
+        (doppelganger_service.rs listen window)."""
+        if self.doppelganger_epochs == 0:
+            return True
+        if self._started_epoch is None:
+            self._started_epoch = epoch
+        return epoch >= self._started_epoch + self.doppelganger_epochs
+
+    # --------------------------------------------------------------- duties
+
+    def poll_duties(self, epoch: int) -> None:
+        """duties_service.rs poll cycle: resolve indices then fetch duties."""
+        indices = [
+            i for i in (
+                self.store.index_of(pk) for pk in self.store.voting_pubkeys()
+            ) if i is not None
+        ]
+        self.attester_duties[epoch] = self.bn.call(
+            lambda c: c.post_attester_duties(epoch, indices)
+        )
+        self.proposer_duties[epoch] = self.bn.call(
+            lambda c: c.get_proposer_duties(epoch)
+        )
+
+    # ------------------------------------------------------------- per slot
+
+    def run_slot(self, slot: int) -> Dict[str, int]:
+        """Execute this slot's duties: propose, attest, aggregate.
+        Returns counters for observability."""
+        epoch = self.spec.epoch_at_slot(slot)
+        if epoch not in self.attester_duties:
+            self.poll_duties(epoch)
+        stats = {"blocks": 0, "attestations": 0, "aggregates": 0}
+        if not self.doppelganger_safe(epoch):
+            return stats
+        stats["blocks"] = self._block_duty(slot)
+        stats["attestations"] = self._attestation_duty(slot)
+        stats["aggregates"] = self._aggregate_duty(slot)
+        return stats
+
+    # ---------------------------------------------------------------- block
+
+    def _block_duty(self, slot: int) -> int:
+        epoch = self.spec.epoch_at_slot(slot)
+        own = {pk.hex(): pk for pk in self.store.voting_pubkeys()}
+        for duty in self.proposer_duties.get(epoch, []):
+            if int(duty["slot"]) != slot:
+                continue
+            pk = own.get(duty["pubkey"][2:])
+            if pk is None:
+                continue
+            fork_info = self._ensure_fork_info()
+            reveal = self.store.sign_randao(pk, epoch, fork_info)
+            out = self.bn.call(lambda c: c.get_block_proposal(slot, reveal))
+            fork = out["version"]
+            block = from_json(self.types.BeaconBlock[fork], out["data"])
+            try:
+                sig = self.store.sign_block(pk, block, fork, fork_info)
+            except NotSafe:
+                return 0
+            signed = self.types.SignedBeaconBlock[fork](
+                message=block, signature=sig
+            )
+            self.bn.call(lambda c: c.publish_block(
+                to_json(self.types.SignedBeaconBlock[fork], signed)
+            ))
+            return 1
+        return 0
+
+    # ----------------------------------------------------------- attestation
+
+    def _attestation_duty(self, slot: int) -> int:
+        epoch = self.spec.epoch_at_slot(slot)
+        duties = [
+            d for d in self.attester_duties.get(epoch, [])
+            if int(d["slot"]) == slot
+        ]
+        if not duties:
+            return 0
+        own = {pk.hex(): pk for pk in self.store.voting_pubkeys()}
+        fork_info = self._ensure_fork_info()
+        submitted = []
+        # One attestation_data per committee index (shared by its members).
+        by_index: Dict[int, List[dict]] = {}
+        for d in duties:
+            by_index.setdefault(int(d["committee_index"]), []).append(d)
+        for committee_index, members in by_index.items():
+            data_json = self.bn.call(
+                lambda c: c.get_attestation_data(slot, committee_index)
+            )
+            data = from_json(self.types.AttestationData, data_json)
+            for duty in members:
+                pk = own.get(duty["pubkey"][2:])
+                if pk is None:
+                    continue
+                try:
+                    sig = self.store.sign_attestation(pk, data, fork_info)
+                except NotSafe:
+                    continue
+                bits = [False] * int(duty["committee_length"])
+                bits[int(duty["validator_committee_index"])] = True
+                att = self.types.Attestation(
+                    aggregation_bits=bits, data=data, signature=sig
+                )
+                submitted.append(to_json(self.types.Attestation, att))
+                self._own_attestations.setdefault(slot, []).append({
+                    "duty": duty, "data": data, "pubkey": pk,
+                })
+        if submitted:
+            self.bn.call(lambda c: c.submit_attestations(submitted))
+        return len(submitted)
+
+    # ------------------------------------------------------------- aggregate
+
+    def _aggregate_duty(self, slot: int) -> int:
+        """At slot+2/3: selected aggregators fetch the best pool aggregate
+        and publish SignedAggregateAndProof
+        (produce_and_publish_aggregates :488)."""
+        produced = self._own_attestations.pop(slot, [])
+        if not produced:
+            return 0
+        fork_info = self._ensure_fork_info()
+        target = self.spec.preset.TARGET_AGGREGATORS_PER_COMMITTEE
+        out = []
+        seen_committees = set()
+        for entry in produced:
+            duty, data, pk = entry["duty"], entry["data"], entry["pubkey"]
+            committee_index = int(duty["committee_index"])
+            if committee_index in seen_committees:
+                continue
+            proof = self.store.sign_selection_proof(pk, slot, fork_info)
+            modulo = max(1, int(duty["committee_length"]) // target)
+            digest = hashlib.sha256(proof).digest()
+            if int.from_bytes(digest[:8], "little") % modulo != 0:
+                continue  # not selected
+            seen_committees.add(committee_index)
+            data_root = self.types.AttestationData.hash_tree_root(data)
+            try:
+                agg_json = self.bn.call(
+                    lambda c: c.get_aggregate(slot, data_root)
+                )
+            except Eth2ClientError:
+                continue
+            aggregate = from_json(self.types.Attestation, agg_json)
+            msg = self.types.AggregateAndProof(
+                aggregator_index=int(duty["validator_index"]),
+                aggregate=aggregate,
+                selection_proof=proof,
+            )
+            sig = self.store.sign_aggregate_and_proof(pk, msg, fork_info)
+            out.append(to_json(
+                self.types.SignedAggregateAndProof,
+                self.types.SignedAggregateAndProof(message=msg, signature=sig),
+            ))
+        if out:
+            try:
+                self.bn.call(lambda c: c.submit_aggregates(out))
+            except Eth2ClientError:
+                return 0
+        return len(out)

@@ -1,0 +1,222 @@
+"""Slashing protection — EIP-3076 interchange-compatible SQLite DB.
+
+Mirror of validator_client/slashing_protection: every signature the
+validator client produces flows through `check_and_insert_*`; the DB
+refuses double block proposals, double attestation votes, and surround
+votes (both directions), and imports/exports the EIP-3076 JSON
+interchange format.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+from typing import Dict, List, Optional
+
+
+class SlashingProtectionError(Exception):
+    pass
+
+
+class NotSafe(SlashingProtectionError):
+    """The proposed signing operation is slashable (or not provably safe)."""
+
+
+class SlashingDatabase:
+    def __init__(self, path: str = ":memory:"):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        cur = self._conn.cursor()
+        cur.execute(
+            "CREATE TABLE IF NOT EXISTS validators ("
+            " id INTEGER PRIMARY KEY, pubkey BLOB UNIQUE NOT NULL)"
+        )
+        cur.execute(
+            "CREATE TABLE IF NOT EXISTS signed_blocks ("
+            " validator_id INTEGER NOT NULL, slot INTEGER NOT NULL,"
+            " signing_root BLOB,"
+            " UNIQUE (validator_id, slot))"
+        )
+        cur.execute(
+            "CREATE TABLE IF NOT EXISTS signed_attestations ("
+            " validator_id INTEGER NOT NULL,"
+            " source_epoch INTEGER NOT NULL, target_epoch INTEGER NOT NULL,"
+            " signing_root BLOB,"
+            " UNIQUE (validator_id, target_epoch))"
+        )
+        self._conn.commit()
+
+    def close(self):
+        self._conn.close()
+
+    # ------------------------------------------------------------ validators
+
+    def register_validator(self, pubkey: bytes) -> int:
+        with self._lock:
+            cur = self._conn.cursor()
+            cur.execute(
+                "INSERT OR IGNORE INTO validators (pubkey) VALUES (?)", (pubkey,)
+            )
+            self._conn.commit()
+            cur.execute("SELECT id FROM validators WHERE pubkey = ?", (pubkey,))
+            return cur.fetchone()[0]
+
+    def _vid(self, pubkey: bytes) -> int:
+        cur = self._conn.cursor()
+        cur.execute("SELECT id FROM validators WHERE pubkey = ?", (pubkey,))
+        row = cur.fetchone()
+        if row is None:
+            raise SlashingProtectionError("validator not registered")
+        return row[0]
+
+    # ---------------------------------------------------------------- blocks
+
+    def check_and_insert_block_proposal(
+        self, pubkey: bytes, slot: int, signing_root: bytes
+    ) -> None:
+        """EIP-3076: refuse a second proposal at the same or lower slot
+        (re-signing the identical root is allowed)."""
+        with self._lock:
+            vid = self._vid(pubkey)
+            cur = self._conn.cursor()
+            cur.execute(
+                "SELECT slot, signing_root FROM signed_blocks"
+                " WHERE validator_id = ? AND slot = ?", (vid, slot),
+            )
+            row = cur.fetchone()
+            if row is not None:
+                if row[1] == signing_root:
+                    return  # idempotent re-sign
+                raise NotSafe(f"double block proposal at slot {slot}")
+            cur.execute(
+                "SELECT MAX(slot) FROM signed_blocks WHERE validator_id = ?",
+                (vid,),
+            )
+            max_slot = cur.fetchone()[0]
+            if max_slot is not None and slot <= max_slot:
+                raise NotSafe(
+                    f"slot {slot} not above previous proposal {max_slot}"
+                )
+            cur.execute(
+                "INSERT INTO signed_blocks VALUES (?, ?, ?)",
+                (vid, slot, signing_root),
+            )
+            self._conn.commit()
+
+    # ---------------------------------------------------------- attestations
+
+    def check_and_insert_attestation(
+        self, pubkey: bytes, source_epoch: int, target_epoch: int,
+        signing_root: bytes,
+    ) -> None:
+        """Refuse double votes and surround votes in either direction."""
+        if source_epoch > target_epoch:
+            raise NotSafe("source epoch after target epoch")
+        with self._lock:
+            vid = self._vid(pubkey)
+            cur = self._conn.cursor()
+            # Double vote: same target, different root.
+            cur.execute(
+                "SELECT signing_root FROM signed_attestations"
+                " WHERE validator_id = ? AND target_epoch = ?",
+                (vid, target_epoch),
+            )
+            row = cur.fetchone()
+            if row is not None:
+                if row[0] == signing_root:
+                    return
+                raise NotSafe(f"double vote for target epoch {target_epoch}")
+            # This attestation surrounds a prior one: s < s' and t > t'.
+            cur.execute(
+                "SELECT 1 FROM signed_attestations WHERE validator_id = ?"
+                " AND source_epoch > ? AND target_epoch < ?",
+                (vid, source_epoch, target_epoch),
+            )
+            if cur.fetchone():
+                raise NotSafe("attestation would surround a prior vote")
+            # A prior one surrounds this: s' < s and t' > t.
+            cur.execute(
+                "SELECT 1 FROM signed_attestations WHERE validator_id = ?"
+                " AND source_epoch < ? AND target_epoch > ?",
+                (vid, source_epoch, target_epoch),
+            )
+            if cur.fetchone():
+                raise NotSafe("attestation would be surrounded by a prior vote")
+            # Monotonic source guard (interchange minimal condition).
+            cur.execute(
+                "SELECT MAX(source_epoch), MAX(target_epoch)"
+                " FROM signed_attestations WHERE validator_id = ?", (vid,),
+            )
+            max_source, max_target = cur.fetchone()
+            if max_target is not None and target_epoch <= max_target:
+                raise NotSafe(
+                    f"target {target_epoch} not above previous {max_target}"
+                )
+            cur.execute(
+                "INSERT INTO signed_attestations VALUES (?, ?, ?, ?)",
+                (vid, source_epoch, target_epoch, signing_root),
+            )
+            self._conn.commit()
+
+    # ----------------------------------------------------------- interchange
+
+    def export_interchange(self, genesis_validators_root: bytes) -> Dict:
+        with self._lock:
+            cur = self._conn.cursor()
+            data = []
+            for vid, pubkey in cur.execute(
+                "SELECT id, pubkey FROM validators"
+            ).fetchall():
+                blocks = [
+                    {"slot": str(slot),
+                     "signing_root": "0x" + (root or b"").hex()}
+                    for slot, root in self._conn.execute(
+                        "SELECT slot, signing_root FROM signed_blocks"
+                        " WHERE validator_id = ?", (vid,),
+                    ).fetchall()
+                ]
+                atts = [
+                    {"source_epoch": str(s), "target_epoch": str(t),
+                     "signing_root": "0x" + (root or b"").hex()}
+                    for s, t, root in self._conn.execute(
+                        "SELECT source_epoch, target_epoch, signing_root"
+                        " FROM signed_attestations WHERE validator_id = ?",
+                        (vid,),
+                    ).fetchall()
+                ]
+                data.append({
+                    "pubkey": "0x" + pubkey.hex(),
+                    "signed_blocks": blocks,
+                    "signed_attestations": atts,
+                })
+        return {
+            "metadata": {
+                "interchange_format_version": "5",
+                "genesis_validators_root":
+                    "0x" + genesis_validators_root.hex(),
+            },
+            "data": data,
+        }
+
+    def import_interchange(self, interchange: Dict) -> None:
+        for entry in interchange.get("data", []):
+            pubkey = bytes.fromhex(entry["pubkey"][2:])
+            self.register_validator(pubkey)
+            with self._lock:
+                vid = self._vid(pubkey)
+                cur = self._conn.cursor()
+                for b in entry.get("signed_blocks", []):
+                    cur.execute(
+                        "INSERT OR IGNORE INTO signed_blocks VALUES (?, ?, ?)",
+                        (vid, int(b["slot"]),
+                         bytes.fromhex(b.get("signing_root", "0x")[2:])),
+                    )
+                for a in entry.get("signed_attestations", []):
+                    cur.execute(
+                        "INSERT OR IGNORE INTO signed_attestations"
+                        " VALUES (?, ?, ?, ?)",
+                        (vid, int(a["source_epoch"]), int(a["target_epoch"]),
+                         bytes.fromhex(a.get("signing_root", "0x")[2:])),
+                    )
+                self._conn.commit()

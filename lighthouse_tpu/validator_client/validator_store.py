@@ -1,0 +1,125 @@
+"""ValidatorStore — key management + safe signing.
+
+Mirror of validator_client/src/validator_store.rs + signing_method.rs: every
+signature flows through slashing protection first; the actual signing is a
+pluggable `SigningMethod` (local keystore here; a web3signer-style remote
+method satisfies the same callable contract).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from lighthouse_tpu.crypto.bls import api as bls
+from lighthouse_tpu.types import ssz
+from lighthouse_tpu.types.spec import (
+    DOMAIN_AGGREGATE_AND_PROOF,
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_BEACON_PROPOSER,
+    DOMAIN_RANDAO,
+    DOMAIN_SELECTION_PROOF,
+    compute_signing_root,
+    get_domain,
+)
+
+from .slashing_protection import SlashingDatabase
+
+
+class LocalKeystoreSigner:
+    """SigningMethod::LocalKeystore (signing_method.rs:80-91)."""
+
+    def __init__(self, secret_key: bls.SecretKey):
+        self.sk = secret_key
+
+    def __call__(self, signing_root: bytes) -> bytes:
+        return self.sk.sign(signing_root).to_bytes()
+
+
+class ValidatorStore:
+    def __init__(self, types, spec, slashing_db: Optional[SlashingDatabase] = None):
+        self.types = types
+        self.spec = spec
+        self.slashing_db = slashing_db or SlashingDatabase()
+        self._signers: Dict[bytes, Callable[[bytes], bytes]] = {}
+        self._indices: Dict[bytes, int] = {}
+
+    # ----------------------------------------------------------------- keys
+
+    def add_validator(self, secret_key: bls.SecretKey,
+                      index: Optional[int] = None) -> bytes:
+        pubkey = secret_key.public_key().to_bytes()
+        self._signers[pubkey] = LocalKeystoreSigner(secret_key)
+        self.slashing_db.register_validator(pubkey)
+        if index is not None:
+            self._indices[pubkey] = index
+        return pubkey
+
+    def add_remote_validator(self, pubkey: bytes,
+                             signer: Callable[[bytes], bytes],
+                             index: Optional[int] = None) -> None:
+        """Web3Signer-style method: any callable(root) -> signature bytes."""
+        self._signers[pubkey] = signer
+        self.slashing_db.register_validator(pubkey)
+        if index is not None:
+            self._indices[pubkey] = index
+
+    def voting_pubkeys(self) -> List[bytes]:
+        return list(self._signers)
+
+    def set_index(self, pubkey: bytes, index: int) -> None:
+        self._indices[pubkey] = index
+
+    def index_of(self, pubkey: bytes) -> Optional[int]:
+        return self._indices.get(pubkey)
+
+    # -------------------------------------------------------------- signing
+
+    def _domain(self, fork_info, domain_type: bytes, epoch: int) -> bytes:
+        return get_domain(
+            self.spec, domain_type, epoch,
+            fork_info["current_version"], fork_info["previous_version"],
+            fork_info["epoch"], fork_info["genesis_validators_root"],
+        )
+
+    def sign_block(self, pubkey: bytes, block, fork: str, fork_info) -> bytes:
+        epoch = self.spec.epoch_at_slot(block.slot)
+        domain = self._domain(fork_info, DOMAIN_BEACON_PROPOSER, epoch)
+        root = compute_signing_root(
+            block, self.types.BeaconBlock[fork], domain
+        )
+        self.slashing_db.check_and_insert_block_proposal(
+            pubkey, block.slot, root
+        )
+        return self._signers[pubkey](root)
+
+    def sign_attestation(self, pubkey: bytes, data, fork_info) -> bytes:
+        domain = self._domain(
+            fork_info, DOMAIN_BEACON_ATTESTER, data.target.epoch
+        )
+        root = compute_signing_root(data, self.types.AttestationData, domain)
+        self.slashing_db.check_and_insert_attestation(
+            pubkey, data.source.epoch, data.target.epoch, root
+        )
+        return self._signers[pubkey](root)
+
+    def sign_randao(self, pubkey: bytes, epoch: int, fork_info) -> bytes:
+        domain = self._domain(fork_info, DOMAIN_RANDAO, epoch)
+        root = compute_signing_root(epoch, ssz.uint64, domain)
+        return self._signers[pubkey](root)
+
+    def sign_selection_proof(self, pubkey: bytes, slot: int, fork_info) -> bytes:
+        domain = self._domain(
+            fork_info, DOMAIN_SELECTION_PROOF, self.spec.epoch_at_slot(slot)
+        )
+        root = compute_signing_root(slot, ssz.uint64, domain)
+        return self._signers[pubkey](root)
+
+    def sign_aggregate_and_proof(self, pubkey: bytes, msg, fork_info) -> bytes:
+        slot = msg.aggregate.data.slot
+        domain = self._domain(
+            fork_info, DOMAIN_AGGREGATE_AND_PROOF, self.spec.epoch_at_slot(slot)
+        )
+        root = compute_signing_root(
+            msg, self.types.AggregateAndProof, domain
+        )
+        return self._signers[pubkey](root)

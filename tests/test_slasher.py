@@ -1,0 +1,91 @@
+"""Slasher: double votes, surround votes (both directions), service wiring
+(reference: slasher/tests + array.rs semantics)."""
+
+import pytest
+
+from lighthouse_tpu.slasher import Slasher, SlasherService
+from lighthouse_tpu.types.containers import make_types
+from lighthouse_tpu.types.spec import minimal_spec
+
+
+@pytest.fixture(scope="module")
+def types():
+    return make_types(minimal_spec().preset)
+
+
+def _att(types, validators, source, target, root=b"\x00" * 32):
+    return types.IndexedAttestation(
+        attesting_indices=list(validators),
+        data=types.AttestationData(
+            slot=target * 8,
+            index=0,
+            beacon_block_root=root,
+            source=types.Checkpoint(epoch=source, root=b"\x00" * 32),
+            target=types.Checkpoint(epoch=target, root=root),
+        ),
+        signature=b"\x00" * 96,
+    )
+
+
+def test_not_slashable_disjoint_votes(types):
+    s = Slasher(n_validators=8)
+    a1 = _att(types, [0, 1], 0, 1)
+    assert s.process_attestation(a1, b"\x01" * 32) == []
+    a2 = _att(types, [0, 1], 1, 2)
+    assert s.process_attestation(a2, b"\x02" * 32) == []
+
+
+def test_double_vote_detected(types):
+    s = Slasher(n_validators=8)
+    a1 = _att(types, [3], 0, 5, root=b"\xaa" * 32)
+    s.process_attestation(a1, b"\xaa" * 32)
+    a2 = _att(types, [3], 1, 5, root=b"\xbb" * 32)
+    findings = s.process_attestation(a2, b"\xbb" * 32)
+    assert len(findings) == 1
+    v, status = findings[0]
+    assert v == 3 and status.kind == "double_vote"
+    assert status.prior is a1
+
+
+def test_surround_vote_detected(types):
+    """New (0, 9) surrounds prior (3, 4)."""
+    s = Slasher(n_validators=8)
+    inner = _att(types, [2], 3, 4)
+    s.process_attestation(inner, b"\x01" * 32)
+    outer = _att(types, [2], 0, 9)
+    findings = s.process_attestation(outer, b"\x02" * 32)
+    assert len(findings) == 1
+    assert findings[0][1].kind == "surrounds"
+    assert findings[0][1].prior is inner
+
+
+def test_surrounded_vote_detected(types):
+    """Prior (0, 9) surrounds new (3, 4)."""
+    s = Slasher(n_validators=8)
+    outer = _att(types, [5], 0, 9)
+    s.process_attestation(outer, b"\x01" * 32)
+    inner = _att(types, [5], 3, 4)
+    findings = s.process_attestation(inner, b"\x02" * 32)
+    assert len(findings) == 1
+    assert findings[0][1].kind == "surrounded"
+    assert findings[0][1].prior is outer
+
+
+def test_only_offending_validators_flagged(types):
+    s = Slasher(n_validators=8)
+    s.process_attestation(_att(types, [0, 1, 2], 3, 4), b"\x01" * 32)
+    findings = s.process_attestation(_att(types, [2, 6], 0, 9), b"\x02" * 32)
+    assert [v for v, _ in findings] == [2]
+
+
+def test_service_builds_attester_slashings(types):
+    s = Slasher(n_validators=8)
+    svc = SlasherService(s, types)
+    svc.on_attestation(_att(types, [4], 3, 4))
+    n = svc.on_attestation(_att(types, [4], 0, 9))
+    assert n == 1
+    slashings = svc.drain_slashings()
+    assert len(slashings) == 1
+    assert slashings[0].attestation_1.data.target.epoch == 4
+    assert slashings[0].attestation_2.data.target.epoch == 9
+    assert svc.drain_slashings() == []
