@@ -74,7 +74,8 @@ class ProtoArrayForkChoice:
         self.equivocating_indices: Set[int] = set()
         # Transient proposer boost: (root, amount applied last sweep).
         self.proposer_boost_root: bytes = b"\x00" * 32
-        self._applied_boost: tuple = (None, 0)  # (node index, amount)
+        self._applied_boost: tuple = (None, 0)  # (node ROOT, amount) — a
+        # root stays valid across prune() remaps; an index would go stale.
         self._append(
             ProtoNode(
                 slot=finalized_slot, root=finalized_root, parent=None,
@@ -192,14 +193,17 @@ class ProtoArrayForkChoice:
             elif cur is not None and new_bal != old_bal:
                 add(cur, new_bal - old_bal)
 
-        # Remove last sweep's boost, apply this sweep's.
-        prev_idx, prev_amount = self._applied_boost
+        # Remove last sweep's boost, apply this sweep's. If the previously
+        # boosted node was pruned, its weight left with it — nothing to undo.
+        prev_root, prev_amount = self._applied_boost
+        prev_idx = self.index_by_root.get(prev_root) if prev_root else None
         if prev_idx is not None:
             add(prev_idx, -prev_amount)
         boost_idx = self.index_by_root.get(self.proposer_boost_root)
         if boost_idx is not None and proposer_boost_amount:
             add(boost_idx, proposer_boost_amount)
-            self._applied_boost = (boost_idx, proposer_boost_amount)
+            self._applied_boost = (self.nodes[boost_idx].root,
+                                   proposer_boost_amount)
         else:
             self._applied_boost = (None, 0)
 

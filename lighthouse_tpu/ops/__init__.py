@@ -27,5 +27,29 @@ try:
     )
     jax.config.update("jax_compilation_cache_dir", _cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    # Cache-write CAP (jax only offers a minimum): XLA:CPU segfaults
+    # serializing very large executables (observed on the monolithic verify
+    # core, whose compile runs >10 min; per-stage entries of a few MB write
+    # fine). Compile time tracks executable size, so skip writes for
+    # anything that took longer than the cap. Guarded: if the private API
+    # moves, the cache just loses the cap.
+    _MAX_CACHE_COMPILE_SECS = float(
+        os.environ.get("LIGHTHOUSE_TPU_JAX_CACHE_MAX_COMPILE_SECS", "400")
+    )
+    from jax._src import compiler as _compiler
+
+    _orig_cache_write = _compiler._cache_write
+
+    def _bounded_cache_write(cache_key, compile_time_secs, module_name,
+                             backend, executable, host_callbacks,
+                             *args, **kwargs):
+        if compile_time_secs > _MAX_CACHE_COMPILE_SECS:
+            return
+        return _orig_cache_write(cache_key, compile_time_secs, module_name,
+                                 backend, executable, host_callbacks,
+                                 *args, **kwargs)
+
+    _compiler._cache_write = _bounded_cache_write
 except Exception:  # pragma: no cover - cache is an optimization only
     pass

@@ -62,20 +62,17 @@ def _next_pow2(n: int, floor: int = 1) -> int:
 # ---------------------------------------------------------------------------
 
 
-def _verify_core(u, pk_proj, sig_proj, sig_checked, set_mask, scalars):
-    """Device graph for one bucket shape.
+def _prepare_pairs(pk_proj, sig_proj, sig_checked, set_mask, scalars):
+    """Aggregation + validity + random-scalar weighting (stage 2).
 
-    u:           (n, 2, 2, L)    hash_to_field outputs per message
     pk_proj:     (n, K, 3, L)    projective pubkeys, padded with infinity
     sig_proj:    (n, 3, 2, L)    projective signatures (infinity for padding)
     sig_checked: (n,) bool       host-side subgroup-check amortization flag
     set_mask:    (n,) bool       True for real sets
     scalars:     (n,) uint64     nonzero random batch coefficients
+    -> (p_aff (n+1,2,L), s_aff (2,2,L), sets_valid ())
     """
-    n = u.shape[0]
-    # H(m_i): the field-heavy half of hash-to-curve, batched.
-    h_proj = h2c.hash_to_g2_device(u)                             # (n, 3, 2, L)
-
+    n = pk_proj.shape[0]
     # Aggregate pubkeys per set: tree over the K axis (complete adds absorb
     # the infinity padding).
     agg = lb.tree_reduce(
@@ -92,40 +89,82 @@ def _verify_core(u, pk_proj, sig_proj, sig_checked, set_mask, scalars):
     rsig = cv.G2.mul_var_scalar(sig_proj, scalars)                # (n, 3, 2, L)
     s_proj = lb.tree_reduce(rsig, cv.G2.add, cv.G2.infinity, n)   # (3, 2, L)
 
-    # Stage the n+1 pairs (the +1 is the constant -g1 against S).
     p_aff = jnp.concatenate(
         [pr.to_affine_g1(a_proj), jnp.broadcast_to(_NEG_G1_AFF, (1, 2, lb.L))]
     )
-    q_aff = jnp.concatenate(
-        [pr.to_affine_g2(h_proj), pr.to_affine_g2(s_proj)[None]]
-    )
-    mask = jnp.concatenate([set_mask, jnp.ones((1,), dtype=bool)])
-
-    pairing_ok = pr.multi_pairing_is_one(p_aff, q_aff, mask)
+    s_aff = pr.to_affine_g2(s_proj)
     sets_valid = jnp.all(
         jnp.where(set_mask, jnp.logical_and(sig_ok, ~agg_inf), True)
     )
+    return p_aff, s_aff, sets_valid
+
+
+def _pairing_check(p_aff, h_proj, s_aff, set_mask, sets_valid):
+    """Final product-of-pairings check (stage 3)."""
+    q_aff = jnp.concatenate([pr.to_affine_g2(h_proj), s_aff[None]])
+    mask = jnp.concatenate([set_mask, jnp.ones((1,), dtype=bool)])
+    pairing_ok = pr.multi_pairing_is_one(p_aff, q_aff, mask)
     return jnp.logical_and(pairing_ok, sets_valid)
 
 
+def _verify_core(u, pk_proj, sig_proj, sig_checked, set_mask, scalars):
+    """The full device graph as one function (jittable; the production path
+    runs it as three separately-jitted stages — see _jitted_core — because
+    XLA:CPU crashes serializing the monolithic executable into the
+    persistent cache, and the staged split costs nothing: arrays never
+    leave the device between stages)."""
+    h_proj = h2c.hash_to_g2_device(u)                             # (n, 3, 2, L)
+    p_aff, s_aff, sets_valid = _prepare_pairs(
+        pk_proj, sig_proj, sig_checked, set_mask, scalars
+    )
+    return _pairing_check(p_aff, h_proj, s_aff, set_mask, sets_valid)
+
+
 @lru_cache(maxsize=None)
-def _jitted_core(n_bucket: int, k_bucket: int, sharded: bool):
+def _jitted_core(n_bucket: int, k_bucket: int, sharded: bool,
+                 n_devices: Optional[int] = None):
+    """Three-stage pipeline, each stage its own jit (own cache entry).
+    `n_devices` bounds the sharded mesh (default: all devices)."""
     del n_bucket, k_bucket  # cache key only; shapes live in the arguments
     if not sharded:
-        return jax.jit(_verify_core)
+        stage1 = jax.jit(h2c.hash_to_g2_device)
+        stage2 = jax.jit(_prepare_pairs)
+        stage3 = jax.jit(_pairing_check)
+
+        def core(u, pk_proj, sig_proj, sig_checked, set_mask, scalars):
+            h_proj = stage1(u)
+            p_aff, s_aff, sets_valid = stage2(
+                pk_proj, sig_proj, sig_checked, set_mask, scalars
+            )
+            return stage3(p_aff, h_proj, s_aff, set_mask, sets_valid)
+
+        return core
 
     from lighthouse_tpu.parallel import mesh as pm
 
-    def core(u, pk_proj, sig_proj, sig_checked, set_mask, scalars):
-        m = pm.get_mesh()
-        sh = pm.batch_sharding(m)
-        args = [
-            jax.lax.with_sharding_constraint(x, sh)
-            for x in (u, pk_proj, sig_proj, sig_checked, set_mask, scalars)
-        ]
-        return _verify_core(*args)
+    def constrained(fn):
+        def wrapped(*args):
+            sh = pm.batch_sharding(pm.get_mesh(n_devices))
+            args = [
+                jax.lax.with_sharding_constraint(x, sh)
+                if hasattr(x, "ndim") and x.ndim >= 1 else x
+                for x in args
+            ]
+            return fn(*args)
+        return wrapped
 
-    return jax.jit(core)
+    stage1 = jax.jit(constrained(h2c.hash_to_g2_device))
+    stage2 = jax.jit(constrained(_prepare_pairs))
+    stage3 = jax.jit(_pairing_check)  # (n+1) axis: leave layout to XLA
+
+    def core(u, pk_proj, sig_proj, sig_checked, set_mask, scalars):
+        h_proj = stage1(u)
+        p_aff, s_aff, sets_valid = stage2(
+            pk_proj, sig_proj, sig_checked, set_mask, scalars
+        )
+        return stage3(p_aff, h_proj, s_aff, set_mask, sets_valid)
+
+    return core
 
 
 # ---------------------------------------------------------------------------

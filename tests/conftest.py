@@ -26,3 +26,9 @@ jax.config.update("jax_platforms", "cpu")
 # file runs; the config update always wins. Same for x64 (uint64 limbs would
 # otherwise be silently truncated to uint32 in any test that skips ops/).
 jax.config.update("jax_enable_x64", True)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running integration test (simulator-scale)"
+    )
