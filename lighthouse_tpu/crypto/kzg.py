@@ -1,0 +1,244 @@
+"""KZG polynomial commitments over BLS12-381 (EIP-4844 / Deneb blobs).
+
+Mirror of crypto/kzg (the c-kzg-4844 wrapper): `Kzg` holds the trusted
+setup (G1 points in LAGRANGE form over the blob evaluation domain + the
+tau*G2 point) and exposes `blob_to_kzg_commitment` (lib.rs:110),
+`compute_blob_kzg_proof`, `verify_blob_kzg_proof`, and the batch-shaped
+`verify_blob_kzg_proof_batch` (lib.rs:81) — a random linear combination
+collapsing N blob proofs into ONE pairing check (the same Fiat-Shamir
+scheme c-kzg uses).
+
+Math shares the BLS oracle's curve/pairing machinery; the batch check is
+pairing-product shaped, i.e. it drops onto the same device pairing kernels
+as signature verification (SURVEY.md §2.7 item 2).
+
+`Kzg.insecure_dev_setup(n)` derives a setup from a KNOWN tau — for tests
+and local nets only, exactly like the reference's interop trusted setup.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional, Sequence, Tuple
+
+from .bls import curves as cv
+from .bls import pairing as pr
+from .bls.constants import R
+
+BYTES_PER_FIELD_ELEMENT = 32
+
+# Primitive root of unity source: 7 generates the multiplicative group mod R
+# up to the 2-adic part (R - 1 = 2^32 * odd).
+_TWO_ADICITY = 32
+_GEN = 7
+
+
+class KzgError(Exception):
+    pass
+
+
+def _root_of_unity(order: int) -> int:
+    if order & (order - 1):
+        raise KzgError("domain size must be a power of two")
+    exp = (R - 1) // order
+    return pow(_GEN, exp, R)
+
+
+def _bit_reverse(n: int, bits: int) -> int:
+    out = 0
+    for _ in range(bits):
+        out = (out << 1) | (n & 1)
+        n >>= 1
+    return out
+
+
+class Kzg:
+    def __init__(self, g1_lagrange: List[tuple], g2_tau: tuple, domain: List[int]):
+        self.n = len(g1_lagrange)
+        self.g1_lagrange = g1_lagrange  # setup in evaluation (Lagrange) basis
+        self.g2_tau = g2_tau
+        self.domain = domain            # bit-reversed roots of unity
+
+    # ----------------------------------------------------------------- setup
+
+    @classmethod
+    def insecure_dev_setup(cls, n: int, tau: int = 0x0BADD00D5EED) -> "Kzg":
+        """Deterministic dev setup with KNOWN tau (never for production)."""
+        w = _root_of_unity(n)
+        bits = n.bit_length() - 1
+        domain = [pow(w, _bit_reverse(i, bits), R) for i in range(n)]
+        # Lagrange basis at tau: L_i(tau) = (tau^n - 1) * w_i / (n * (tau - w_i))
+        tau_n = pow(tau, n, R)
+        lag = []
+        for wi in domain:
+            num = (tau_n - 1) * wi % R
+            den = n * (tau - wi) % R
+            lag.append(num * pow(den, R - 2, R) % R)
+        g1_lagrange = [cv.g1_mul(cv.G1_GEN, li) for li in lag]
+        g2_tau = cv.g2_mul(cv.G2_GEN, tau)
+        return cls(g1_lagrange, g2_tau, domain)
+
+    # ------------------------------------------------------------- encoding
+
+    @staticmethod
+    def blob_to_field_elements(blob: bytes) -> List[int]:
+        if len(blob) % BYTES_PER_FIELD_ELEMENT:
+            raise KzgError("blob length not a multiple of 32")
+        out = []
+        for i in range(0, len(blob), BYTES_PER_FIELD_ELEMENT):
+            fe = int.from_bytes(blob[i:i + 32], "big")
+            if fe >= R:
+                raise KzgError("blob element not canonical")
+            out.append(fe)
+        return out
+
+    def _check_len(self, evals: Sequence[int]) -> None:
+        if len(evals) != self.n:
+            raise KzgError(f"expected {self.n} field elements, got {len(evals)}")
+
+    # ----------------------------------------------------------- commitment
+
+    def _msm(self, scalars: Sequence[int]) -> tuple:
+        """MSM over the Lagrange setup — the TPU-batchable hot loop."""
+        acc = None
+        for pt, s in zip(self.g1_lagrange, scalars):
+            if s == 0:
+                continue
+            term = cv.g1_mul(pt, s)
+            if term is None:
+                continue
+            acc = term if acc is None else cv.g1_add(acc, term)
+        return acc
+
+    def blob_to_kzg_commitment(self, blob: bytes) -> tuple:
+        evals = self.blob_to_field_elements(blob)
+        self._check_len(evals)
+        return self._msm(evals)
+
+    # ---------------------------------------------------------- evaluation
+
+    def evaluate_polynomial(self, evals: Sequence[int], z: int) -> int:
+        """Barycentric evaluation on the bit-reversed domain."""
+        self._check_len(evals)
+        for i, wi in enumerate(self.domain):
+            if z == wi:
+                return evals[i]
+        zn = (pow(z, self.n, R) - 1) % R
+        total = 0
+        for ev, wi in zip(evals, self.domain):
+            total = (total + ev * wi % R * pow((z - wi) % R, R - 2, R)) % R
+        return total * zn % R * pow(self.n, R - 2, R) % R
+
+    # --------------------------------------------------------------- proofs
+
+    def compute_kzg_proof(self, blob: bytes, z: int) -> Tuple[tuple, int]:
+        """-> (proof_point, y = p(z)). Quotient in evaluation form:
+        q_i = (p_i - y) / (w_i - z)."""
+        evals = self.blob_to_field_elements(blob)
+        self._check_len(evals)
+        y = self.evaluate_polynomial(evals, z)
+        q = []
+        for ev, wi in zip(evals, self.domain):
+            if wi == z:
+                q.append(0)  # handled below via special-casing
+                continue
+            q.append((ev - y) * pow((wi - z) % R, R - 2, R) % R)
+        if z in self.domain:
+            # On-domain z: q_j = sum_{i != j} (p_i - y) w_i / (n... ) —
+            # use the standard c-kzg on-domain formula.
+            j = self.domain.index(z)
+            qj = 0
+            for i, (ev, wi) in enumerate(zip(evals, self.domain)):
+                if i == j:
+                    continue
+                term = (ev - y) * wi % R
+                term = term * pow((z * ((z - wi) % R)) % R, R - 2, R) % R
+                qj = (qj + term) % R
+            q[j] = qj
+        return self._msm(q), y
+
+    def compute_blob_kzg_proof(self, blob: bytes, commitment: tuple) -> tuple:
+        z = self._challenge(blob, commitment)
+        proof, _y = self.compute_kzg_proof(blob, z)
+        return proof
+
+    # --------------------------------------------------------------- verify
+
+    def verify_kzg_proof(self, commitment: tuple, z: int, y: int,
+                         proof: tuple) -> bool:
+        """e(C - y G1, G2) == e(W, tau G2 - z G2)  <=>
+        e(C - y G1, -G2) * e(W, tau G2 - z G2) == 1."""
+        c_minus_y = cv.g1_add(commitment, cv.g1_neg(cv.g1_mul(cv.G1_GEN, y))) \
+            if y else commitment
+        x_minus_z = cv.g2_add(self.g2_tau, cv.g2_neg(cv.g2_mul(cv.G2_GEN, z))) \
+            if z else self.g2_tau
+        return pr.pairings_product_is_one([
+            (c_minus_y, cv.g2_neg(cv.G2_GEN)),
+            (proof, x_minus_z),
+        ])
+
+    def verify_blob_kzg_proof(self, blob: bytes, commitment: tuple,
+                              proof: tuple) -> bool:
+        z = self._challenge(blob, commitment)
+        evals = self.blob_to_field_elements(blob)
+        y = self.evaluate_polynomial(evals, z)
+        return self.verify_kzg_proof(commitment, z, y, proof)
+
+    def verify_blob_kzg_proof_batch(
+        self, blobs: Sequence[bytes], commitments: Sequence[tuple],
+        proofs: Sequence[tuple],
+    ) -> bool:
+        """Random linear combination -> ONE pairing-product check
+        (verify_blob_kzg_proof_batch, crypto/kzg/src/lib.rs:81)."""
+        if not (len(blobs) == len(commitments) == len(proofs)):
+            raise KzgError("length mismatch")
+        if not blobs:
+            return True
+        zs, ys = [], []
+        for blob, commitment in zip(blobs, commitments):
+            z = self._challenge(blob, commitment)
+            zs.append(z)
+            ys.append(self.evaluate_polynomial(
+                self.blob_to_field_elements(blob), z
+            ))
+        # Powers of a Fiat-Shamir r weight each equation.
+        r = self._batch_challenge(commitments, zs, ys, proofs)
+        r_pows = [pow(r, i, R) for i in range(len(blobs))]
+
+        # sum r^i (C_i - y_i G1 + z_i W_i)  paired with -G2,
+        # plus  sum r^i W_i  paired with tau G2.
+        lhs_acc = None
+        w_acc = None
+        for ri, commitment, z, y, w in zip(r_pows, commitments, zs, ys, proofs):
+            term = cv.g1_add(commitment,
+                             cv.g1_neg(cv.g1_mul(cv.G1_GEN, y)) if y else None) \
+                if y else commitment
+            term = cv.g1_add(term, cv.g1_mul(w, z)) if z else term
+            term = cv.g1_mul(term, ri)
+            lhs_acc = term if lhs_acc is None else cv.g1_add(lhs_acc, term)
+            wt = cv.g1_mul(w, ri)
+            w_acc = wt if w_acc is None else cv.g1_add(w_acc, wt)
+        return pr.pairings_product_is_one([
+            (lhs_acc, cv.g2_neg(cv.G2_GEN)),
+            (w_acc, self.g2_tau),
+        ])
+
+    # ------------------------------------------------------------ challenges
+
+    def _challenge(self, blob: bytes, commitment: tuple) -> int:
+        h = hashlib.sha256()
+        h.update(b"FSBLOBVERIFY_V1_")
+        h.update(len(blob).to_bytes(8, "big"))
+        h.update(blob)
+        h.update(cv.g1_to_compressed(commitment))
+        return int.from_bytes(h.digest(), "big") % R
+
+    def _batch_challenge(self, commitments, zs, ys, proofs) -> int:
+        h = hashlib.sha256()
+        h.update(b"RCKZGBATCH___V1_")
+        for c, z, y, w in zip(commitments, zs, ys, proofs):
+            h.update(cv.g1_to_compressed(c))
+            h.update(z.to_bytes(32, "big"))
+            h.update(y.to_bytes(32, "big"))
+            h.update(cv.g1_to_compressed(w))
+        return int.from_bytes(h.digest(), "big") % R

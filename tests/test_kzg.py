@@ -1,0 +1,87 @@
+"""KZG commitments: evaluation, proof verify, blob proofs, batch verify
+(reference: crypto/kzg + c-kzg semantics; ef_test KZG case shapes §4.2)."""
+
+import pytest
+
+from lighthouse_tpu.crypto.bls.constants import R
+from lighthouse_tpu.crypto.kzg import Kzg, KzgError
+
+N = 16  # tiny dev domain
+
+
+@pytest.fixture(scope="module")
+def kzg():
+    return Kzg.insecure_dev_setup(N)
+
+
+def _blob(vals):
+    out = b""
+    for v in vals:
+        out += (v % R).to_bytes(32, "big")
+    return out
+
+
+@pytest.fixture(scope="module")
+def blob():
+    return _blob([7 * i + 3 for i in range(N)])
+
+
+def test_domain_is_roots_of_unity(kzg):
+    for w in kzg.domain:
+        assert pow(w, N, R) == 1
+    assert len(set(kzg.domain)) == N
+
+
+def test_evaluate_on_and_off_domain(kzg, blob):
+    evals = kzg.blob_to_field_elements(blob)
+    # on-domain: returns the evaluation directly
+    assert kzg.evaluate_polynomial(evals, kzg.domain[3]) == evals[3]
+    # constant polynomial sanity off-domain
+    const = kzg.blob_to_field_elements(_blob([5] * N))
+    assert kzg.evaluate_polynomial(const, 12345) == 5
+
+
+def test_kzg_proof_roundtrip(kzg, blob):
+    commitment = kzg.blob_to_kzg_commitment(blob)
+    z = 0xDEADBEEF % R
+    proof, y = kzg.compute_kzg_proof(blob, z)
+    assert kzg.verify_kzg_proof(commitment, z, y, proof)
+    # wrong claimed value fails
+    assert not kzg.verify_kzg_proof(commitment, z, (y + 1) % R, proof)
+    # wrong point fails
+    assert not kzg.verify_kzg_proof(commitment, (z + 1) % R, y, proof)
+
+
+def test_kzg_proof_on_domain_point(kzg, blob):
+    commitment = kzg.blob_to_kzg_commitment(blob)
+    z = kzg.domain[5]
+    proof, y = kzg.compute_kzg_proof(blob, z)
+    evals = kzg.blob_to_field_elements(blob)
+    assert y == evals[5]
+    assert kzg.verify_kzg_proof(commitment, z, y, proof)
+
+
+def test_blob_proof_and_batch(kzg):
+    blobs = [_blob([i * 17 + j for j in range(N)]) for i in range(3)]
+    commitments = [kzg.blob_to_kzg_commitment(b) for b in blobs]
+    proofs = [kzg.compute_blob_kzg_proof(b, c)
+              for b, c in zip(blobs, commitments)]
+    for b, c, p in zip(blobs, commitments, proofs):
+        assert kzg.verify_blob_kzg_proof(b, c, p)
+    assert kzg.verify_blob_kzg_proof_batch(blobs, commitments, proofs)
+    # one corrupted proof poisons the batch
+    bad = list(proofs)
+    bad[1] = proofs[0]
+    assert not kzg.verify_blob_kzg_proof_batch(blobs, commitments, bad)
+    # mismatched commitment fails singly
+    assert not kzg.verify_blob_kzg_proof(blobs[0], commitments[1], proofs[0])
+
+
+def test_non_canonical_blob_rejected(kzg):
+    bad = (R).to_bytes(32, "big") + b"\x00" * 32 * (N - 1)
+    with pytest.raises(KzgError):
+        kzg.blob_to_field_elements(bad)
+
+
+def test_empty_batch_is_valid(kzg):
+    assert kzg.verify_blob_kzg_proof_batch([], [], [])
