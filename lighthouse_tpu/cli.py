@@ -1,0 +1,257 @@
+"""lighthouse-tpu CLI — node daemons + dev tooling.
+
+Mirror of lighthouse/src/main.rs (clap App) + lcli/src/main.rs:66-1006:
+
+  bn                  run a beacon node (HTTP API, mock or HTTP engine)
+  vc                  run a validator client against one or more BNs
+  interop-genesis     write an interop genesis BeaconState SSZ
+  skip-slots          advance a state SSZ through N empty slots
+  transition-blocks   apply a block SSZ to a pre-state SSZ
+  block-root          hash_tree_root of a block SSZ
+  state-root          hash_tree_root of a state SSZ
+  db                  inspect a datadir (database_manager analog)
+
+All SSZ files are capella-fork containers of the chosen preset.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _types_spec(preset: str):
+    from lighthouse_tpu.types.containers import make_types
+    from lighthouse_tpu.types.spec import mainnet_spec, minimal_spec
+
+    spec = minimal_spec() if preset == "minimal" else mainnet_spec()
+    return make_types(spec.preset), spec
+
+
+def cmd_bn(args) -> int:
+    from lighthouse_tpu.client import ClientBuilder, ClientConfig
+
+    cfg = ClientConfig(
+        preset=args.preset,
+        datadir=args.datadir,
+        n_interop_validators=args.interop_validators,
+        genesis_time=args.genesis_time or int(time.time()),
+        http_port=args.http_port,
+        bls_backend=args.bls_backend,
+        mock_el=args.engine_url is None,
+        engine_url=args.engine_url,
+        jwt_secret=bytes.fromhex(args.jwt_secret) if args.jwt_secret else None,
+        real_clock=True,
+    )
+    client = ClientBuilder(cfg).build()
+    client.start()
+    print(f"beacon node up: http API on {client.api.url if client.api else 'off'}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        client.stop()
+    return 0
+
+
+def cmd_vc(args) -> int:
+    from lighthouse_tpu.common.eth2_client import BeaconNodeHttpClient
+    from lighthouse_tpu.state_transition.genesis import (
+        generate_deterministic_keypairs,
+    )
+    from lighthouse_tpu.validator_client import (
+        BeaconNodeFallback,
+        SlashingDatabase,
+        ValidatorClient,
+        ValidatorStore,
+    )
+
+    types, spec = _types_spec(args.preset)
+    store = ValidatorStore(
+        types, spec,
+        SlashingDatabase(args.slashing_db) if args.slashing_db
+        else SlashingDatabase(),
+    )
+    keys = generate_deterministic_keypairs(args.interop_keys_end)
+    for i in range(args.interop_keys_start, args.interop_keys_end):
+        store.add_validator(keys[i], index=i)
+    clients = [BeaconNodeHttpClient(u) for u in args.beacon_nodes.split(",")]
+    vc = ValidatorClient(store, BeaconNodeFallback(clients), types, spec,
+                         doppelganger_epochs=args.doppelganger_epochs)
+    genesis = clients[0].get_genesis()
+    from lighthouse_tpu.common.slot_clock import SystemTimeSlotClock
+
+    clock = SystemTimeSlotClock(int(genesis["genesis_time"]),
+                                spec.seconds_per_slot)
+    print(f"validator client up: {len(store.voting_pubkeys())} keys")
+    last = None
+    try:
+        while True:
+            slot = clock.now()
+            if slot is not None and slot != last:
+                last = slot
+                stats = vc.run_slot(slot)
+                print(f"slot {slot}: {stats}")
+            time.sleep(min(1.0, clock.duration_to_next_slot()))
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_interop_genesis(args) -> int:
+    from lighthouse_tpu.state_transition import genesis as gen
+    from lighthouse_tpu.types.spec import ForkName
+
+    types, spec = _types_spec(args.preset)
+    keys = gen.generate_deterministic_keypairs(args.validator_count)
+    state = gen.interop_genesis_state(
+        types, spec, keys, genesis_time=args.genesis_time
+    )
+    data = types.BeaconState[ForkName.CAPELLA].serialize(state)
+    with open(args.output, "wb") as f:
+        f.write(data)
+    print(f"wrote {len(data)} bytes ({args.validator_count} validators)")
+    return 0
+
+
+def cmd_skip_slots(args) -> int:
+    from lighthouse_tpu.state_transition import slot_processing as sp
+    from lighthouse_tpu.types.spec import ForkName
+
+    types, spec = _types_spec(args.preset)
+    cls = types.BeaconState[ForkName.CAPELLA]
+    state = cls.deserialize(open(args.pre, "rb").read())
+    sp.process_slots(state, types, spec, state.slot + args.slots,
+                     fork=ForkName.CAPELLA)
+    open(args.output, "wb").write(cls.serialize(state))
+    print(f"advanced to slot {state.slot}")
+    return 0
+
+
+def cmd_transition_blocks(args) -> int:
+    from lighthouse_tpu.state_transition import block_processing as bp
+    from lighthouse_tpu.state_transition import slot_processing as sp
+    from lighthouse_tpu.types.spec import ForkName
+
+    types, spec = _types_spec(args.preset)
+    scls = types.BeaconState[ForkName.CAPELLA]
+    bcls = types.SignedBeaconBlock[ForkName.CAPELLA]
+    state = scls.deserialize(open(args.pre, "rb").read())
+    block = bcls.deserialize(open(args.block, "rb").read())
+    sp.state_transition(
+        state, types, spec, block, ForkName.CAPELLA,
+        verify_signatures=bp.VerifySignatures.FALSE
+        if args.no_signature_verification else None,
+        verify_state_root=not args.no_state_root_check,
+    )
+    open(args.output, "wb").write(scls.serialize(state))
+    print(f"post-state at slot {state.slot}")
+    return 0
+
+
+def cmd_block_root(args) -> int:
+    from lighthouse_tpu.types.spec import ForkName
+
+    types, _ = _types_spec(args.preset)
+    cls = types.SignedBeaconBlock[ForkName.CAPELLA]
+    signed = cls.deserialize(open(args.path, "rb").read())
+    root = types.BeaconBlock[ForkName.CAPELLA].hash_tree_root(signed.message)
+    print("0x" + root.hex())
+    return 0
+
+
+def cmd_state_root(args) -> int:
+    from lighthouse_tpu.types.spec import ForkName
+
+    types, _ = _types_spec(args.preset)
+    cls = types.BeaconState[ForkName.CAPELLA]
+    state = cls.deserialize(open(args.path, "rb").read())
+    print("0x" + cls.hash_tree_root(state).hex())
+    return 0
+
+
+def cmd_db(args) -> int:
+    from lighthouse_tpu.store import HotColdDB, NativeStore
+    from lighthouse_tpu.store.kv import DBColumn
+
+    types, spec = _types_spec(args.preset)
+    db = HotColdDB.open(args.datadir, types, spec)
+    counts = {}
+    for col in ("blk", "ste", "bss", "bma"):
+        counts[col] = sum(1 for _ in db.hot.iter_column_from(col))
+    info = {
+        "split_slot": db.split.slot,
+        "hot_counts": counts,
+        "anchor": bool(db.get_anchor_info()),
+    }
+    print(json.dumps(info, indent=2))
+    db.close()
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="lighthouse-tpu")
+    p.add_argument("--preset", default="minimal",
+                   choices=["minimal", "mainnet"])
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    bn = sub.add_parser("bn", help="run a beacon node")
+    bn.add_argument("--datadir")
+    bn.add_argument("--http-port", type=int, default=5052)
+    bn.add_argument("--interop-validators", type=int, default=64)
+    bn.add_argument("--genesis-time", type=int)
+    bn.add_argument("--bls-backend", choices=["oracle", "tpu"])
+    bn.add_argument("--engine-url")
+    bn.add_argument("--jwt-secret")
+    bn.set_defaults(fn=cmd_bn)
+
+    vc = sub.add_parser("vc", help="run a validator client")
+    vc.add_argument("--beacon-nodes", default="http://127.0.0.1:5052")
+    vc.add_argument("--interop-keys-start", type=int, default=0)
+    vc.add_argument("--interop-keys-end", type=int, default=64)
+    vc.add_argument("--slashing-db")
+    vc.add_argument("--doppelganger-epochs", type=int, default=0)
+    vc.set_defaults(fn=cmd_vc)
+
+    ig = sub.add_parser("interop-genesis")
+    ig.add_argument("validator_count", type=int)
+    ig.add_argument("--genesis-time", type=int, default=1_600_000_000)
+    ig.add_argument("--output", default="genesis.ssz")
+    ig.set_defaults(fn=cmd_interop_genesis)
+
+    sk = sub.add_parser("skip-slots")
+    sk.add_argument("pre")
+    sk.add_argument("slots", type=int)
+    sk.add_argument("--output", default="post.ssz")
+    sk.set_defaults(fn=cmd_skip_slots)
+
+    tb = sub.add_parser("transition-blocks")
+    tb.add_argument("pre")
+    tb.add_argument("block")
+    tb.add_argument("--output", default="post.ssz")
+    tb.add_argument("--no-signature-verification", action="store_true")
+    tb.add_argument("--no-state-root-check", action="store_true")
+    tb.set_defaults(fn=cmd_transition_blocks)
+
+    br = sub.add_parser("block-root")
+    br.add_argument("path")
+    br.set_defaults(fn=cmd_block_root)
+
+    sr = sub.add_parser("state-root")
+    sr.add_argument("path")
+    sr.set_defaults(fn=cmd_state_root)
+
+    db = sub.add_parser("db", help="inspect a datadir")
+    db.add_argument("datadir")
+    db.set_defaults(fn=cmd_db)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

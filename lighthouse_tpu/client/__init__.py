@@ -1,0 +1,5 @@
+"""Client assembly (reference: beacon_node/client, L10)."""
+
+from .builder import Client, ClientBuilder, ClientConfig
+
+__all__ = ["Client", "ClientBuilder", "ClientConfig"]

@@ -1,0 +1,173 @@
+"""ClientBuilder — assembles a full beacon node from parts.
+
+Mirror of beacon_node/client/src/builder.rs:157-995: genesis strategy
+(interop keys | checkpoint state | resume from store), disk or memory
+store, execution layer (mock or HTTP engine), beacon processor, network
+service, HTTP API, and the per-slot timer driving clock-based duties
+(timer/ + state_advance_timer.rs). `Client.run_slot` gives deterministic
+ticks; `start`/`stop` run the threaded timer.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from lighthouse_tpu.beacon_chain import BeaconChain
+from lighthouse_tpu.beacon_processor import BeaconProcessor
+from lighthouse_tpu.common.slot_clock import ManualSlotClock, SystemTimeSlotClock
+from lighthouse_tpu.execution_layer import ExecutionLayer, MockExecutionEngine
+from lighthouse_tpu.http_api import BeaconApiServer
+from lighthouse_tpu.network import NetworkService
+from lighthouse_tpu.op_pool import OperationPool
+from lighthouse_tpu.state_transition import genesis as genesis_mod
+from lighthouse_tpu.store import HotColdDB, StoreConfig
+from lighthouse_tpu.types.containers import make_types
+from lighthouse_tpu.types.spec import ForkName, mainnet_spec, minimal_spec
+
+
+@dataclass
+class ClientConfig:
+    preset: str = "minimal"                  # "mainnet" | "minimal"
+    datadir: Optional[str] = None            # None => memory store
+    n_interop_validators: int = 64
+    genesis_time: int = 1_600_000_000
+    genesis_state_ssz: Optional[bytes] = None  # checkpoint-sync anchor state
+    http_port: Optional[int] = None          # None => no API server
+    bls_backend: Optional[str] = None        # None => oracle; "tpu" => device
+    mock_el: bool = True
+    engine_url: Optional[str] = None
+    jwt_secret: Optional[bytes] = None
+    real_clock: bool = False
+    slots_per_restore_point: int = 2048
+
+
+class Client:
+    def __init__(self, config: ClientConfig, chain: BeaconChain,
+                 processor: BeaconProcessor,
+                 network: Optional[NetworkService],
+                 api: Optional[BeaconApiServer]):
+        self.config = config
+        self.chain = chain
+        self.processor = processor
+        self.network = network
+        self.api = api
+        self._timer: Optional[threading.Thread] = None
+        self._running = False
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> "Client":
+        self.processor.start()
+        if self.api is not None:
+            self.api.start()
+        self._running = True
+        self._timer = threading.Thread(target=self._slot_timer, daemon=True)
+        self._timer.start()
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        self.processor.stop()
+        if self.api is not None:
+            self.api.stop()
+        if self._timer:
+            self._timer.join(timeout=2)
+        self.chain.store.hot.sync()
+
+    def _slot_timer(self) -> None:
+        """Per-slot tick (timer/): recompute head at the slot boundary,
+        advance pool pruning; state_advance_timer's pre-computation is
+        covered by the snapshot cache."""
+        import time as _time
+
+        clock = self.chain.slot_clock
+        last = clock.now_or_genesis()
+        while self._running:
+            _time.sleep(min(0.05, clock.duration_to_next_slot()))
+            now = clock.now_or_genesis()
+            if now != last:
+                last = now
+                self.run_slot_tick(now)
+
+    def run_slot_tick(self, slot: int) -> None:
+        self.chain.recompute_head()
+        if self.chain.op_pool is not None:
+            self.chain.op_pool.prune_attestations(
+                self.chain.spec.epoch_at_slot(slot)
+            )
+
+
+class ClientBuilder:
+    def __init__(self, config: Optional[ClientConfig] = None):
+        self.config = config or ClientConfig()
+
+    def build(self, transport=None, peer_id: str = "node") -> Client:
+        cfg = self.config
+        spec = minimal_spec() if cfg.preset == "minimal" else mainnet_spec()
+        types = make_types(spec.preset)
+
+        # --- store (builder.rs:1030 disk_store) --------------------------
+        if cfg.datadir:
+            store = HotColdDB.open(
+                cfg.datadir, types, spec,
+                config=StoreConfig(
+                    slots_per_restore_point=cfg.slots_per_restore_point
+                ),
+            )
+        else:
+            store = HotColdDB(types, spec)
+
+        # --- genesis strategy (config.rs:21-43 ClientGenesis) ------------
+        if cfg.genesis_state_ssz is not None:
+            fork = ForkName.CAPELLA
+            genesis_state = types.BeaconState[fork].deserialize(
+                cfg.genesis_state_ssz
+            )
+        else:
+            keys = genesis_mod.generate_deterministic_keypairs(
+                cfg.n_interop_validators
+            )
+            genesis_state = genesis_mod.interop_genesis_state(
+                types, spec, keys, genesis_time=cfg.genesis_time
+            )
+
+        # --- execution layer ---------------------------------------------
+        execution_layer = None
+        if cfg.engine_url:
+            execution_layer = ExecutionLayer.http(
+                cfg.engine_url, cfg.jwt_secret or b"\x00" * 32, types
+            )
+        elif cfg.mock_el:
+            engine = MockExecutionEngine(
+                types,
+                terminal_block_hash=bytes(
+                    genesis_state.latest_execution_payload_header.block_hash
+                ),
+            )
+            execution_layer = ExecutionLayer(engine, types=types)
+
+        op_pool = OperationPool(types, spec)
+        chain = BeaconChain(
+            types, spec, genesis_state,
+            store=store,
+            bls_backend=cfg.bls_backend,
+            execution_layer=execution_layer,
+            op_pool=op_pool,
+        )
+        if cfg.real_clock:
+            chain.slot_clock = SystemTimeSlotClock(
+                genesis_state.genesis_time, spec.seconds_per_slot
+            )
+        op_pool.restore(store)
+
+        processor = BeaconProcessor()
+        network = None
+        if transport is not None:
+            network = NetworkService(peer_id, transport, chain,
+                                     processor=processor)
+        api = None
+        if cfg.http_port is not None:
+            api = BeaconApiServer(chain, network=network, port=cfg.http_port)
+        return Client(cfg, chain, processor, network, api)

@@ -1,0 +1,61 @@
+"""CLI dev tools: interop-genesis, skip-slots, transition, roots, db
+(reference: lcli/src/main.rs tool surface)."""
+
+import json
+
+from lighthouse_tpu.cli import main
+
+
+def test_interop_genesis_and_roots(tmp_path):
+    out = tmp_path / "genesis.ssz"
+    assert main(["interop-genesis", "16", "--output", str(out)]) == 0
+    assert out.stat().st_size > 0
+    assert main(["state-root", str(out)]) == 0
+
+
+def test_skip_slots(tmp_path, capsys):
+    pre = tmp_path / "genesis.ssz"
+    post = tmp_path / "post.ssz"
+    main(["interop-genesis", "16", "--output", str(pre)])
+    assert main(["skip-slots", str(pre), "3", "--output", str(post)]) == 0
+    assert "advanced to slot 3" in capsys.readouterr().out
+
+
+def test_transition_blocks(tmp_path, capsys):
+    from lighthouse_tpu.testing.harness import BeaconChainHarness
+
+    h = BeaconChainHarness(n_validators=16)
+    h.advance_slot()
+    signed, root = h.make_block()
+    pre = tmp_path / "pre.ssz"
+    blk = tmp_path / "block.ssz"
+    post = tmp_path / "post.ssz"
+    fork = h.chain.fork_at(1)
+    pre.write_bytes(
+        h.types.BeaconState[fork].serialize(h.chain.head.state)
+    )
+    blk.write_bytes(h.types.SignedBeaconBlock[fork].serialize(signed))
+    assert main([
+        "transition-blocks", str(pre), str(blk), "--output", str(post),
+    ]) == 0
+    assert "post-state at slot 1" in capsys.readouterr().out
+
+    # block-root matches the harness root
+    assert main(["block-root", str(blk)]) == 0
+    assert capsys.readouterr().out.strip() == "0x" + root.hex()
+
+
+def test_db_inspect(tmp_path, capsys):
+    from lighthouse_tpu.store import HotColdDB
+    from lighthouse_tpu.testing.harness import BeaconChainHarness
+    from lighthouse_tpu.types.containers import make_types
+    from lighthouse_tpu.types.spec import minimal_spec
+
+    spec = minimal_spec()
+    types = make_types(spec.preset)
+    db = HotColdDB.open(str(tmp_path / "data"), types, spec)
+    db.hot.put("blk", b"\x01" * 32, b"fake")
+    db.close()
+    assert main(["db", str(tmp_path / "data")]) == 0
+    info = json.loads(capsys.readouterr().out)
+    assert info["hot_counts"]["blk"] == 1
