@@ -1,0 +1,116 @@
+"""Data availability checker — Deneb blob gating for block import.
+
+Mirror of beacon_chain/src/data_availability_checker.rs (+ overflow LRU
+:53): a block whose body commits to blobs is importable only once every
+committed blob has arrived and KZG-verified (batched —
+`verify_blob_kzg_proof_batch` rides the same pairing kernels as signature
+verification). Pending components live in a bounded LRU keyed by block
+root; whichever of {block, last blob} arrives second completes the entry.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+class AvailabilityError(Exception):
+    pass
+
+
+@dataclass
+class PendingComponents:
+    block: Optional[object] = None              # ExecutionPendingBlock
+    blobs: Dict[int, object] = field(default_factory=dict)  # index -> sidecar
+
+
+class DataAvailabilityChecker:
+    MAX_PENDING = 64  # OverflowLRUCache capacity analog
+
+    def __init__(self, types, kzg=None):
+        self.types = types
+        self.kzg = kzg
+        self._pending: "OrderedDict[bytes, PendingComponents]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    # ---------------------------------------------------------------- intake
+
+    def expected_blob_count(self, block) -> int:
+        body = block.message.body
+        if hasattr(body, "blob_kzg_commitments"):
+            return len(body.blob_kzg_commitments)
+        return 0
+
+    def put_gossip_blob(self, block_root: bytes, sidecar) -> Optional[object]:
+        """Store a KZG-verified sidecar; returns the completed
+        ExecutionPendingBlock when it was the last missing piece
+        (put_gossip_blob :226)."""
+        max_blobs = getattr(self.types.preset, "MAX_BLOBS_PER_BLOCK", 6)
+        if int(sidecar.index) >= max_blobs:
+            raise AvailabilityError(
+                f"blob index {int(sidecar.index)} >= MAX_BLOBS_PER_BLOCK"
+            )
+        if self.kzg is not None:
+            ok = self.kzg.verify_blob_kzg_proof(
+                bytes(sidecar.blob),
+                self._decompress_commitment(sidecar.kzg_commitment),
+                self._decompress_commitment(sidecar.kzg_proof),
+            )
+            if not ok:
+                raise AvailabilityError(f"blob {sidecar.index} failed KZG")
+        with self._lock:
+            entry = self._entry(block_root)
+            entry.blobs[int(sidecar.index)] = sidecar
+            return self._try_complete(block_root, entry)
+
+    def put_pending_block(self, block_root: bytes, pending) -> Optional[object]:
+        """Block arrived; returns it when all blobs are already here, else
+        parks it (MissingComponents)."""
+        n = self.expected_blob_count(pending.signed_block)
+        if n == 0:
+            return pending
+        with self._lock:
+            entry = self._entry(block_root)
+            entry.block = pending
+            return self._try_complete(block_root, entry)
+
+    def _entry(self, block_root: bytes) -> PendingComponents:
+        if block_root in self._pending:
+            self._pending.move_to_end(block_root)
+            return self._pending[block_root]
+        entry = PendingComponents()
+        self._pending[block_root] = entry
+        while len(self._pending) > self.MAX_PENDING:
+            self._pending.popitem(last=False)
+        return entry
+
+    def _try_complete(self, block_root: bytes, entry: PendingComponents):
+        if entry.block is None:
+            return None
+        body = entry.block.signed_block.message.body
+        want = self.expected_blob_count(entry.block.signed_block)
+        # Drop sidecars whose commitment conflicts with the block's list — a
+        # KZG-self-consistent gossip blob from a third party must not make
+        # the honest block fail; it just doesn't count toward availability.
+        for i, sc in list(entry.blobs.items()):
+            if i >= want or bytes(sc.kzg_commitment) != \
+                    bytes(body.blob_kzg_commitments[i]):
+                del entry.blobs[i]
+        if len(entry.blobs) < want:
+            return None
+        del self._pending[block_root]
+        return entry.block
+
+    def missing_blob_indices(self, block_root: bytes, block) -> List[int]:
+        want = self.expected_blob_count(block)
+        with self._lock:
+            have = self._pending.get(block_root, PendingComponents()).blobs
+        return [i for i in range(want) if i not in have]
+
+    @staticmethod
+    def _decompress_commitment(data: bytes):
+        from lighthouse_tpu.crypto.bls import curves as cv
+
+        return cv.g1_from_compressed(bytes(data))

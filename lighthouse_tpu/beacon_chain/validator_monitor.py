@@ -1,0 +1,97 @@
+"""Validator monitor — per-validator observability inside the node.
+
+Mirror of beacon_chain/src/validator_monitor.rs:386 (auto-register :60-69):
+registered validators get hit/miss/delay accounting for attestations
+(gossip + included-in-block) and proposals, surfaced as metrics and a
+summary dict per epoch.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from lighthouse_tpu.common.metrics import REGISTRY
+
+
+@dataclass
+class MonitoredValidator:
+    index: int
+    attestations_seen: int = 0
+    last_attestation_delay: float = 0.0  # full distribution -> histogram
+    attestations_included: int = 0
+    blocks_proposed: int = 0
+    missed_attestations: int = 0
+
+
+class ValidatorMonitor:
+    # Bound on auto-registered entries: per-entry state is O(1), but a
+    # mainnet gossip firehose must not register the whole network.
+    MAX_AUTO_REGISTERED = 65536
+
+    def __init__(self, auto_register: bool = False):
+        self.auto_register = auto_register
+        self._validators: Dict[int, MonitoredValidator] = {}
+        self._lock = threading.Lock()
+        self._seen_counter = REGISTRY.counter(
+            "validator_monitor_attestations_total",
+            "gossip attestations seen from monitored validators",
+        )
+        self._delay_hist = REGISTRY.histogram(
+            "validator_monitor_attestation_delay_seconds",
+            "delay from slot start to gossip arrival",
+        )
+
+    def register(self, index: int) -> None:
+        with self._lock:
+            self._validators.setdefault(index, MonitoredValidator(index))
+
+    def is_monitored(self, index: int) -> bool:
+        with self._lock:
+            if self.auto_register and \
+                    len(self._validators) < self.MAX_AUTO_REGISTERED:
+                self._validators.setdefault(index, MonitoredValidator(index))
+            return index in self._validators
+
+    # ---------------------------------------------------------------- events
+
+    def on_gossip_attestation(self, validator_index: int,
+                              delay_seconds: float = 0.0) -> None:
+        if not self.is_monitored(validator_index):
+            return
+        with self._lock:
+            v = self._validators[validator_index]
+            v.attestations_seen += 1
+            v.last_attestation_delay = delay_seconds
+        self._seen_counter.inc()
+        self._delay_hist.observe(delay_seconds)
+
+    def on_attestation_in_block(self, validator_indices) -> None:
+        with self._lock:
+            for idx in validator_indices:
+                if idx in self._validators:
+                    self._validators[idx].attestations_included += 1
+
+    def on_block_proposed(self, proposer_index: int) -> None:
+        if not self.is_monitored(proposer_index):
+            return
+        with self._lock:
+            self._validators[proposer_index].blocks_proposed += 1
+
+    def on_epoch_summary(self, epoch: int, attested: Set[int]) -> Dict[int, dict]:
+        """End-of-epoch sweep: who missed. Returns a per-validator summary."""
+        out = {}
+        with self._lock:
+            for idx, v in self._validators.items():
+                if idx not in attested:
+                    v.missed_attestations += 1
+                out[idx] = {
+                    "epoch": epoch,
+                    "seen": v.attestations_seen,
+                    "included": v.attestations_included,
+                    "proposed": v.blocks_proposed,
+                    "missed": v.missed_attestations,
+                }
+        return out

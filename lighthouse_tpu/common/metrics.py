@@ -1,0 +1,202 @@
+"""Metrics registry — prometheus text-format counters/gauges/histograms.
+
+Mirror of common/lighthouse_metrics (global registry + start_timer/
+stop_timer macros, src/lib.rs:1-40) and beacon_node/http_metrics (the
+scrape endpoint). Stdlib-only: the exposition format is plain text.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+_DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+    2.5, 5.0, 10.0,
+)
+
+
+class Counter:
+    def __init__(self, name: str, help_text: str):
+        self.name = name
+        self.help = help_text
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def get(self) -> float:
+        with self._lock:
+            return self._value
+
+    def expose(self) -> str:
+        with self._lock:
+            value = self._value
+        return (f"# HELP {self.name} {self.help}\n"
+                f"# TYPE {self.name} counter\n"
+                f"{self.name} {value}\n")
+
+
+class Gauge:
+    def __init__(self, name: str, help_text: str):
+        self.name = name
+        self.help = help_text
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = value
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    def get(self) -> float:
+        with self._lock:
+            return self._value
+
+    def expose(self) -> str:
+        with self._lock:
+            value = self._value
+        return (f"# HELP {self.name} {self.help}\n"
+                f"# TYPE {self.name} gauge\n"
+                f"{self.name} {value}\n")
+
+
+class Histogram:
+    def __init__(self, name: str, help_text: str,
+                 buckets: Tuple[float, ...] = _DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_text
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._total = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._sum += value
+            self._total += 1
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def start_timer(self) -> "HistogramTimer":
+        return HistogramTimer(self)
+
+    def expose(self) -> str:
+        with self._lock:  # consistent sum/count/bucket snapshot
+            counts = list(self._counts)
+            total = self._total
+            sum_ = self._sum
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        cumulative = 0
+        for b, c in zip(self.buckets, counts):
+            cumulative += c
+            out.append(f'{self.name}_bucket{{le="{b}"}} {cumulative}')
+        cumulative += counts[-1]
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {cumulative}')
+        out.append(f"{self.name}_sum {sum_}")
+        out.append(f"{self.name}_count {total}")
+        return "\n".join(out) + "\n"
+
+
+class HistogramTimer:
+    def __init__(self, histogram: Histogram):
+        self.histogram = histogram
+        self.start = time.perf_counter()
+
+    def stop(self) -> float:
+        dt = time.perf_counter() - self.start
+        self.histogram.observe(dt)
+        return dt
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_text: str = "") -> Counter:
+        return self._get_or_make(name, lambda: Counter(name, help_text))
+
+    def gauge(self, name: str, help_text: str = "") -> Gauge:
+        return self._get_or_make(name, lambda: Gauge(name, help_text))
+
+    def histogram(self, name: str, help_text: str = "",
+                  buckets: Tuple[float, ...] = _DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_make(
+            name, lambda: Histogram(name, help_text, buckets)
+        )
+
+    def _get_or_make(self, name, factory):
+        with self._lock:
+            if name not in self._metrics:
+                self._metrics[name] = factory()
+            return self._metrics[name]
+
+    def gather(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return "".join(m.expose() for m in metrics)
+
+
+# The global registry (lighthouse_metrics' lazy_static DEFAULT_REGISTRY).
+REGISTRY = Registry()
+
+
+class MetricsServer:
+    """GET /metrics scrape endpoint (http_metrics/src/lib.rs:1-3)."""
+
+    def __init__(self, registry: Optional[Registry] = None, port: int = 0):
+        reg = registry or REGISTRY
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                if self.path != "/metrics":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = reg.gather().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self.server.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()

@@ -1,0 +1,282 @@
+"""Validator key management: EIP-2333 HD derivation + EIP-2335 keystores.
+
+Mirror of crypto/eth2_key_derivation (hierarchical derive_master_SK /
+derive_child_SK with HKDF_mod_r) and crypto/eth2_keystore (EIP-2335 JSON
+keystores: scrypt or pbkdf2 KDF, AES-128-CTR cipher, SHA-256 checksum).
+
+AES-128-CTR is implemented inline on top of hashlib/hmac-free primitives
+(pure-Python AES, stdlib-only — the image has no cryptography package);
+scrypt/pbkdf2 come from hashlib.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import os
+import unicodedata
+from typing import List, Optional
+
+from .bls.constants import R as _CURVE_ORDER
+
+
+# ---------------------------------------------------------------------------
+# EIP-2333 key derivation
+# ---------------------------------------------------------------------------
+
+
+def _hkdf_extract(salt: bytes, ikm: bytes) -> bytes:
+    return hmac.new(salt, ikm, hashlib.sha256).digest()
+
+
+def _hkdf_expand(prk: bytes, info: bytes, length: int) -> bytes:
+    out = b""
+    t = b""
+    i = 1
+    while len(out) < length:
+        t = hmac.new(prk, t + info + bytes([i]), hashlib.sha256).digest()
+        out += t
+        i += 1
+    return out[:length]
+
+
+def _hkdf_mod_r(ikm: bytes, key_info: bytes = b"") -> int:
+    """IETF BLS KeyGen: loop HKDF until nonzero mod r."""
+    salt = b"BLS-SIG-KEYGEN-SALT-"
+    sk = 0
+    while sk == 0:
+        salt = hashlib.sha256(salt).digest()
+        prk = _hkdf_extract(salt, ikm + b"\x00")
+        okm = _hkdf_expand(prk, key_info + (48).to_bytes(2, "big"), 48)
+        sk = int.from_bytes(okm, "big") % _CURVE_ORDER
+    return sk
+
+
+def _ikm_to_lamport_sk(ikm: bytes, salt: bytes) -> List[bytes]:
+    prk = _hkdf_extract(salt, ikm)
+    okm = _hkdf_expand(prk, b"", 255 * 32)
+    return [okm[i * 32:(i + 1) * 32] for i in range(255)]
+
+
+def _parent_sk_to_lamport_pk(parent_sk: int, index: int) -> bytes:
+    salt = index.to_bytes(4, "big")
+    ikm = parent_sk.to_bytes(32, "big")
+    lamport_0 = _ikm_to_lamport_sk(ikm, salt)
+    not_ikm = bytes(b ^ 0xFF for b in ikm)
+    lamport_1 = _ikm_to_lamport_sk(not_ikm, salt)
+    combined = b"".join(
+        hashlib.sha256(x).digest() for x in lamport_0 + lamport_1
+    )
+    return hashlib.sha256(combined).digest()
+
+
+def derive_master_sk(seed: bytes) -> int:
+    """EIP-2333 derive_master_SK."""
+    if len(seed) < 32:
+        raise ValueError("seed must be >= 32 bytes")
+    return _hkdf_mod_r(seed)
+
+
+def derive_child_sk(parent_sk: int, index: int) -> int:
+    """EIP-2333 derive_child_SK."""
+    return _hkdf_mod_r(_parent_sk_to_lamport_pk(parent_sk, index))
+
+
+def derive_path(seed: bytes, path: str) -> int:
+    """EIP-2334 path derivation, e.g. m/12381/3600/0/0/0."""
+    parts = path.strip().split("/")
+    if parts[0] != "m":
+        raise ValueError("path must start with m")
+    sk = derive_master_sk(seed)
+    for p in parts[1:]:
+        sk = derive_child_sk(sk, int(p))
+    return sk
+
+
+def validator_keypath(index: int) -> str:
+    """EIP-2334 voting key path for validator `index`."""
+    return f"m/12381/3600/{index}/0/0"
+
+
+# ---------------------------------------------------------------------------
+# AES-128-CTR (pure Python, stdlib only)
+# ---------------------------------------------------------------------------
+
+_SBOX = None
+
+
+def _aes_sbox():
+    global _SBOX
+    if _SBOX is not None:
+        return _SBOX
+    p = q = 1
+    sbox = [0] * 256
+    sbox[0] = 0x63
+    while True:
+        # multiply p by 3 in GF(2^8)
+        p = p ^ ((p << 1) & 0xFF) ^ (0x1B if p & 0x80 else 0)
+        # divide q by 3
+        q ^= (q << 1) & 0xFF
+        q ^= (q << 2) & 0xFF
+        q ^= (q << 4) & 0xFF
+        q ^= 0x09 if q & 0x80 else 0
+        x = q ^ ((q << 1) | (q >> 7)) & 0xFF ^ ((q << 2) | (q >> 6)) & 0xFF \
+            ^ ((q << 3) | (q >> 5)) & 0xFF ^ ((q << 4) | (q >> 4)) & 0xFF
+        sbox[p] = (x ^ 0x63) & 0xFF
+        if p == 1:
+            break
+    _SBOX = sbox
+    return sbox
+
+
+def _aes_expand_key(key: bytes) -> List[List[int]]:
+    sbox = _aes_sbox()
+    rcon = 1
+    words = [list(key[i:i + 4]) for i in range(0, 16, 4)]
+    for i in range(4, 44):
+        w = list(words[i - 1])
+        if i % 4 == 0:
+            w = w[1:] + w[:1]
+            w = [sbox[b] for b in w]
+            w[0] ^= rcon
+            rcon = ((rcon << 1) ^ 0x1B) & 0xFF if rcon & 0x80 else rcon << 1
+        words.append([a ^ b for a, b in zip(words[i - 4], w)])
+    return words
+
+
+def _aes_encrypt_block(words, block: bytes) -> bytes:
+    sbox = _aes_sbox()
+    state = [list(block[i::4]) for i in range(4)]  # column-major
+
+    def add_round_key(rnd):
+        for c in range(4):
+            for r in range(4):
+                state[r][c] ^= words[rnd * 4 + c][r]
+
+    def sub_shift():
+        for r in range(4):
+            row = [sbox[b] for b in state[r]]
+            state[r] = row[r:] + row[:r]
+
+    def xtime(b):
+        return ((b << 1) ^ 0x1B) & 0xFF if b & 0x80 else b << 1
+
+    def mix():
+        for c in range(4):
+            a = [state[r][c] for r in range(4)]
+            state[0][c] = xtime(a[0]) ^ (xtime(a[1]) ^ a[1]) ^ a[2] ^ a[3]
+            state[1][c] = a[0] ^ xtime(a[1]) ^ (xtime(a[2]) ^ a[2]) ^ a[3]
+            state[2][c] = a[0] ^ a[1] ^ xtime(a[2]) ^ (xtime(a[3]) ^ a[3])
+            state[3][c] = (xtime(a[0]) ^ a[0]) ^ a[1] ^ a[2] ^ xtime(a[3])
+
+    add_round_key(0)
+    for rnd in range(1, 10):
+        sub_shift()
+        mix()
+        add_round_key(rnd)
+    sub_shift()
+    add_round_key(10)
+    out = bytearray(16)
+    for c in range(4):
+        for r in range(4):
+            out[c * 4 + r] = state[r][c]
+    return bytes(out)
+
+
+def aes128_ctr(key: bytes, iv: bytes, data: bytes) -> bytes:
+    words = _aes_expand_key(key)
+    out = bytearray()
+    counter = int.from_bytes(iv, "big")
+    for i in range(0, len(data), 16):
+        ks = _aes_encrypt_block(words, counter.to_bytes(16, "big"))
+        chunk = data[i:i + 16]
+        out += bytes(a ^ b for a, b in zip(chunk, ks))
+        counter = (counter + 1) % (1 << 128)
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# EIP-2335 keystore
+# ---------------------------------------------------------------------------
+
+
+class KeystoreError(Exception):
+    pass
+
+
+def _normalize_password(password: str) -> bytes:
+    """EIP-2335: NFKD normalize, then strip C0 (00-1F), DEL (7F) AND C1
+    (80-9F) control codes."""
+    norm = unicodedata.normalize("NFKD", password)
+    return "".join(
+        c for c in norm if ord(c) > 0x1F and not (0x7F <= ord(c) <= 0x9F)
+    ).encode()
+
+
+def encrypt_keystore(secret: bytes, password: str, pubkey: bytes,
+                     path: str = "", kdf: str = "pbkdf2",
+                     iterations: int = 262144) -> dict:
+    """Create an EIP-2335 keystore JSON object."""
+    pw = _normalize_password(password)
+    salt = os.urandom(32)
+    iv = os.urandom(16)
+    if kdf == "pbkdf2":
+        dk = hashlib.pbkdf2_hmac("sha256", pw, salt, iterations, dklen=32)
+        kdf_module = {
+            "function": "pbkdf2",
+            "params": {"dklen": 32, "c": iterations, "prf": "hmac-sha256",
+                       "salt": salt.hex()},
+            "message": "",
+        }
+    elif kdf == "scrypt":
+        dk = hashlib.scrypt(pw, salt=salt, n=2**14, r=8, p=1, dklen=32,
+                            maxmem=2**31 - 1)
+        kdf_module = {
+            "function": "scrypt",
+            "params": {"dklen": 32, "n": 2**14, "r": 8, "p": 1,
+                       "salt": salt.hex()},
+            "message": "",
+        }
+    else:
+        raise KeystoreError(f"unsupported kdf {kdf}")
+    cipher_text = aes128_ctr(dk[:16], iv, secret)
+    checksum = hashlib.sha256(dk[16:32] + cipher_text).digest()
+    return {
+        "crypto": {
+            "kdf": kdf_module,
+            "checksum": {"function": "sha256", "params": {},
+                         "message": checksum.hex()},
+            "cipher": {
+                "function": "aes-128-ctr",
+                "params": {"iv": iv.hex()},
+                "message": cipher_text.hex(),
+            },
+        },
+        "pubkey": pubkey.hex(),
+        "path": path,
+        "uuid": hashlib.sha256(pubkey + salt).hexdigest()[:32],
+        "version": 4,
+    }
+
+
+def decrypt_keystore(keystore: dict, password: str) -> bytes:
+    pw = _normalize_password(password)
+    crypto = keystore["crypto"]
+    kdf = crypto["kdf"]
+    salt = bytes.fromhex(kdf["params"]["salt"])
+    if kdf["function"] == "pbkdf2":
+        dk = hashlib.pbkdf2_hmac("sha256", pw, salt, kdf["params"]["c"],
+                                 dklen=kdf["params"]["dklen"])
+    elif kdf["function"] == "scrypt":
+        p = kdf["params"]
+        dk = hashlib.scrypt(pw, salt=salt, n=p["n"], r=p["r"], p=p["p"],
+                            dklen=p["dklen"], maxmem=2**31 - 1)
+    else:
+        raise KeystoreError(f"unsupported kdf {kdf['function']}")
+    cipher_text = bytes.fromhex(crypto["cipher"]["message"])
+    checksum = hashlib.sha256(dk[16:32] + cipher_text).digest()
+    if checksum.hex() != crypto["checksum"]["message"]:
+        raise KeystoreError("invalid password (checksum mismatch)")
+    iv = bytes.fromhex(crypto["cipher"]["params"]["iv"])
+    return aes128_ctr(dk[:16], iv, cipher_text)

@@ -1,0 +1,6 @@
+"""Eth1 deposit-contract follower (reference: beacon_node/eth1)."""
+
+from .deposit_cache import DepositCache, DepositCacheError, Eth1Block
+from .service import Eth1Service
+
+__all__ = ["DepositCache", "DepositCacheError", "Eth1Block", "Eth1Service"]

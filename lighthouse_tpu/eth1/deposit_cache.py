@@ -1,0 +1,174 @@
+"""Deposit cache: the contract's incremental Merkle tree + proofs.
+
+Mirror of beacon_node/eth1 (eth1/src/lib.rs:4-17, deposit_cache.rs): holds
+every deposit log in order, maintains the 32-deep incremental Merkle tree
+the deposit contract computes on-chain, and serves (deposit, proof) pairs
+for block production plus the deposit_root/deposit_count snapshots that
+feed eth1-data voting.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+DEPOSIT_TREE_DEPTH = 32
+
+
+def _sha(a: bytes, b: bytes) -> bytes:
+    return hashlib.sha256(a + b).digest()
+
+
+_ZERO = [b"\x00" * 32]
+for _ in range(DEPOSIT_TREE_DEPTH + 1):
+    _ZERO.append(_sha(_ZERO[-1], _ZERO[-1]))
+
+
+class DepositCacheError(Exception):
+    pass
+
+
+@dataclass
+class Eth1Block:
+    number: int
+    hash: bytes
+    timestamp: int
+    deposit_root: Optional[bytes] = None
+    deposit_count: Optional[int] = None
+
+
+class DepositTree:
+    """Incremental Merkle tree, mix-in-length root (the deposit contract)."""
+
+    def __init__(self):
+        self.leaves: List[bytes] = []
+        self._branch: List[bytes] = [_ZERO[i] for i in range(DEPOSIT_TREE_DEPTH)]
+
+    def push(self, leaf: bytes) -> None:
+        index = len(self.leaves)
+        self.leaves.append(leaf)
+        node = leaf
+        size = index + 1
+        for h in range(DEPOSIT_TREE_DEPTH):
+            if (size >> h) & 1:
+                self._branch[h] = node
+                break
+            node = _sha(self._branch[h], node)
+
+    def root(self) -> bytes:
+        node = _ZERO[0]
+        size = len(self.leaves)
+        for h in range(DEPOSIT_TREE_DEPTH):
+            if (size >> h) & 1:
+                node = _sha(self._branch[h], node)
+            else:
+                node = _sha(node, _ZERO[h])
+        return _sha(node, len(self.leaves).to_bytes(32, "little"))
+
+    def root_at_count(self, deposit_count: int) -> bytes:
+        """Root of the subtree holding the first `deposit_count` leaves —
+        what a historical eth1_data.deposit_root snapshot committed to."""
+        if deposit_count > len(self.leaves):
+            raise DepositCacheError("count beyond tree")
+        node = _ZERO[0]
+        layer = list(self.leaves[:deposit_count])
+        for h in range(DEPOSIT_TREE_DEPTH):
+            nxt = []
+            for i in range(0, len(layer), 2):
+                a = layer[i]
+                b = layer[i + 1] if i + 1 < len(layer) else _ZERO[h]
+                nxt.append(_sha(a, b))
+            layer = nxt
+        node = layer[0] if layer else _ZERO[DEPOSIT_TREE_DEPTH]
+        return _sha(node, deposit_count.to_bytes(32, "little"))
+
+    def proof(self, index: int, deposit_count: Optional[int] = None) -> List[bytes]:
+        """Merkle branch for leaf `index` against the subtree of the first
+        `deposit_count` leaves (+ the mixed-in count as the final element —
+        the spec's DEPOSIT_TREE_DEPTH+1 proof). Proofs must verify against
+        the eth1_data snapshot the STATE committed to, which generally lags
+        the cache frontier (the reference proves against the same
+        deposit_count parameter)."""
+        if deposit_count is None:
+            deposit_count = len(self.leaves)
+        if deposit_count > len(self.leaves):
+            raise DepositCacheError("count beyond tree")
+        if index >= deposit_count:
+            raise DepositCacheError("leaf out of range")
+        # Recompute layer by layer (cache-light; proofs are rare next to
+        # pushes — production block assembly asks for <= 16 at a time).
+        layer = list(self.leaves[:deposit_count])
+        branch = []
+        idx = index
+        for h in range(DEPOSIT_TREE_DEPTH):
+            sibling = idx ^ 1
+            branch.append(layer[sibling] if sibling < len(layer) else _ZERO[h])
+            nxt = []
+            for i in range(0, len(layer), 2):
+                a = layer[i]
+                b = layer[i + 1] if i + 1 < len(layer) else _ZERO[h]
+                nxt.append(_sha(a, b))
+            layer = nxt
+            idx //= 2
+        branch.append(deposit_count.to_bytes(32, "little"))
+        return branch
+
+
+class DepositCache:
+    def __init__(self, types=None):
+        self.types = types
+        self.tree = DepositTree()
+        self.deposit_data: List[object] = []   # DepositData containers
+        self.blocks: List[Eth1Block] = []
+
+    # -------------------------------------------------------------- deposits
+
+    def insert_deposit(self, deposit_data, leaf: Optional[bytes] = None) -> None:
+        if leaf is None:
+            leaf = self.types.DepositData.hash_tree_root(deposit_data)
+        self.tree.push(leaf)
+        self.deposit_data.append(deposit_data)
+
+    def deposit_count(self) -> int:
+        return len(self.deposit_data)
+
+    def deposit_root(self) -> bytes:
+        return self.tree.root()
+
+    def get_deposits(self, start: int, end: int,
+                     deposit_count: Optional[int] = None
+                     ) -> List[Tuple[object, List[bytes]]]:
+        """(deposit_data, proof) pairs for indices [start, end), proven
+        against the `deposit_count` snapshot the state's eth1_data holds —
+        what block production includes while
+        state.eth1_deposit_index < eth1_data.deposit_count."""
+        if deposit_count is None:
+            deposit_count = len(self.deposit_data)
+        if end > deposit_count:
+            raise DepositCacheError("not enough deposits in snapshot")
+        return [
+            (self.deposit_data[i], self.tree.proof(i, deposit_count))
+            for i in range(start, end)
+        ]
+
+    # ---------------------------------------------------------- eth1 blocks
+
+    def insert_eth1_block(self, block: Eth1Block) -> None:
+        self.blocks.append(block)
+
+    def eth1_data_for_voting(self, lookahead_timestamp: int):
+        """Pick the latest eth1 block older than the follow distance —
+        the eth1-data voting input (eth1/src/service.rs semantics)."""
+        candidates = [
+            b for b in self.blocks
+            if b.timestamp <= lookahead_timestamp and b.deposit_root
+        ]
+        if not candidates:
+            return None
+        best = max(candidates, key=lambda b: b.number)
+        return {
+            "deposit_root": best.deposit_root,
+            "deposit_count": best.deposit_count,
+            "block_hash": best.hash,
+        }

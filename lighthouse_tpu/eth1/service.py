@@ -1,0 +1,36 @@
+"""Eth1 follower service: polls an execution endpoint for deposit logs and
+block snapshots into the cache (eth1/src/service.rs update loop; the HTTP
+fetch plugs into the same JSON-RPC client as the engine API)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from .deposit_cache import DepositCache, Eth1Block
+
+
+class Eth1Service:
+    def __init__(self, cache: Optional[DepositCache] = None,
+                 fetch_fn: Optional[Callable] = None):
+        """`fetch_fn(last_block_number) -> (new_blocks, new_deposits)` is the
+        pollable source — a JSON-RPC log fetcher in production, a stub in
+        tests (mirrors the reference's mocked endpoints)."""
+        self.cache = cache or DepositCache()
+        self.fetch_fn = fetch_fn
+        self._last_block = -1
+        self._lock = threading.Lock()
+
+    def update(self) -> int:
+        """One poll cycle; returns how many new deposits were ingested."""
+        if self.fetch_fn is None:
+            return 0
+        with self._lock:
+            blocks, deposits = self.fetch_fn(self._last_block)
+            for dep in deposits:
+                self.cache.insert_deposit(*dep) if isinstance(dep, tuple) \
+                    else self.cache.insert_deposit(dep)
+            for blk in blocks:
+                self.cache.insert_eth1_block(blk)
+                self._last_block = max(self._last_block, blk.number)
+            return len(deposits)

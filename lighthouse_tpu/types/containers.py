@@ -340,6 +340,24 @@ def make_types(preset: Preset) -> SimpleNamespace:
     class ExecutionPayloadHeaderDeneb(Container):
         FIELDS = _payload_header_fields(2)
 
+    # -- blob sidecars (deneb) ----------------------------------------------
+
+    Blob = ByteVector(32 * P.FIELD_ELEMENTS_PER_BLOB)
+    # Merkle depth of blob_kzg_commitments inside the body generalized index
+    # (KZG_COMMITMENT_INCLUSION_PROOF_DEPTH).
+    KZG_INCLUSION_PROOF_DEPTH = 17
+
+    class BlobSidecar(Container):
+        FIELDS = [
+            ("index", uint64),
+            ("blob", Blob),
+            ("kzg_commitment", Bytes48),
+            ("kzg_proof", Bytes48),
+            ("signed_block_header", SignedBeaconBlockHeader),
+            ("kzg_commitment_inclusion_proof",
+             Vector(Bytes32, KZG_INCLUSION_PROOF_DEPTH)),
+        ]
+
     # -- block bodies per fork ----------------------------------------------
 
     _body_base = [

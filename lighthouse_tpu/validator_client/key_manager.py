@@ -1,0 +1,75 @@
+"""Account + validator management: wallets, keystore CRUD, bulk operations.
+
+Mirror of account_manager (wallet/validator keystore CRUD) and
+validator_manager (bulk create/import): a `Wallet` derives voting keys on
+the EIP-2334 path from a seed mnemonic-equivalent, writes EIP-2335
+keystores into a validator directory layout, and imports them into a
+ValidatorStore.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Tuple
+
+from lighthouse_tpu.crypto import keystore as ks
+from lighthouse_tpu.crypto.bls.api import SecretKey
+
+
+class Wallet:
+    """Seed-backed HD wallet (eth2_wallet analog; mnemonic handling reduced
+    to the seed bytes — BIP-39 wordlists are I/O, not cryptography)."""
+
+    def __init__(self, seed: bytes, name: str = "wallet"):
+        if len(seed) < 32:
+            raise ValueError("seed must be >= 32 bytes")
+        self.seed = seed
+        self.name = name
+        self.next_index = 0
+
+    def derive_validator_key(self, index: Optional[int] = None) -> Tuple[int, SecretKey]:
+        if index is None:
+            index = self.next_index
+            self.next_index += 1
+        sk_int = ks.derive_path(self.seed, ks.validator_keypath(index))
+        return index, SecretKey(sk_int)
+
+
+def create_validators(
+    wallet: Wallet, count: int, password: str, validators_dir: str,
+) -> List[dict]:
+    """Bulk create (validator_manager create_validators): derive, encrypt,
+    write `<dir>/<pubkey>/voting-keystore.json`."""
+    out = []
+    for _ in range(count):
+        idx, sk = wallet.derive_validator_key()
+        pubkey = sk.public_key().to_bytes()
+        keystore = ks.encrypt_keystore(
+            sk.to_bytes(), password, pubkey,
+            path=ks.validator_keypath(idx),
+        )
+        vdir = os.path.join(validators_dir, "0x" + pubkey.hex())
+        os.makedirs(vdir, exist_ok=True)
+        with open(os.path.join(vdir, "voting-keystore.json"), "w") as f:
+            json.dump(keystore, f)
+        out.append(keystore)
+    return out
+
+
+def import_validators(validators_dir: str, password: str, store) -> int:
+    """Decrypt every keystore in the directory layout into the
+    ValidatorStore (account_manager validator import)."""
+    n = 0
+    if not os.path.isdir(validators_dir):
+        return 0
+    for entry in sorted(os.listdir(validators_dir)):
+        path = os.path.join(validators_dir, entry, "voting-keystore.json")
+        if not os.path.exists(path):
+            continue
+        with open(path) as f:
+            keystore = json.load(f)
+        secret = ks.decrypt_keystore(keystore, password)
+        store.add_validator(SecretKey.from_bytes(secret))
+        n += 1
+    return n

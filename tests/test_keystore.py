@@ -1,0 +1,82 @@
+"""Key management: EIP-2333 vectors, EIP-2335 keystore roundtrips, wallet +
+bulk create/import (reference: crypto/eth2_key_derivation + eth2_keystore +
+account_manager/validator_manager)."""
+
+import pytest
+
+from lighthouse_tpu.crypto import keystore as ks
+from lighthouse_tpu.crypto.bls.api import SecretKey
+from lighthouse_tpu.validator_client.key_manager import (
+    Wallet,
+    create_validators,
+    import_validators,
+)
+
+
+def test_eip2333_official_vector():
+    seed = bytes.fromhex(
+        "c55257c360c07c72029aebc1b53c05ed0362ada38ead3e3e9efa3708e5349553"
+        "1f09a6987599d18264c1e1c92f2cf141630c7a3c4ab7c81b2f001698e7463b04"
+    )
+    master = ks.derive_master_sk(seed)
+    assert master == 6083874454709270928345386274498605044986640685124978867557563392430687146096
+    child = ks.derive_child_sk(master, 0)
+    assert child == 20397789859736650942317412262472558107875392172444076792671091975210932703118
+
+
+def test_aes128_fips197_vector():
+    key = bytes.fromhex("000102030405060708090a0b0c0d0e0f")
+    pt = bytes.fromhex("00112233445566778899aabbccddeeff")
+    out = ks._aes_encrypt_block(ks._aes_expand_key(key), pt)
+    assert out.hex() == "69c4e0d86a7b0430d8cdb78070b4c55a"
+
+
+def test_keystore_roundtrip_pbkdf2():
+    sk = SecretKey(12345)
+    keystore = ks.encrypt_keystore(
+        sk.to_bytes(), "hunter2", sk.public_key().to_bytes(),
+        iterations=1024,  # fast for tests
+    )
+    assert keystore["version"] == 4
+    out = ks.decrypt_keystore(keystore, "hunter2")
+    assert out == sk.to_bytes()
+    with pytest.raises(ks.KeystoreError):
+        ks.decrypt_keystore(keystore, "wrong-password")
+
+
+def test_keystore_roundtrip_scrypt():
+    sk = SecretKey(999)
+    keystore = ks.encrypt_keystore(
+        sk.to_bytes(), "pässword", sk.public_key().to_bytes(), kdf="scrypt",
+    )
+    assert ks.decrypt_keystore(keystore, "pässword") == sk.to_bytes()
+
+
+def test_wallet_derivation_deterministic():
+    w1 = Wallet(b"\x01" * 32)
+    w2 = Wallet(b"\x01" * 32)
+    i1, k1 = w1.derive_validator_key()
+    i2, k2 = w2.derive_validator_key()
+    assert i1 == i2 == 0
+    assert k1.to_bytes() == k2.to_bytes()
+    _, k3 = w1.derive_validator_key()
+    assert k3.to_bytes() != k1.to_bytes()
+
+
+def test_bulk_create_and_import(tmp_path):
+    from lighthouse_tpu.types.containers import make_types
+    from lighthouse_tpu.types.spec import minimal_spec
+    from lighthouse_tpu.validator_client import ValidatorStore
+
+    wallet = Wallet(b"\x02" * 32)
+    created = create_validators(wallet, 3, "pw", str(tmp_path), )
+    assert len(created) == 3
+
+    spec = minimal_spec()
+    store = ValidatorStore(make_types(spec.preset), spec)
+    n = import_validators(str(tmp_path), "pw", store)
+    assert n == 3
+    assert len(store.voting_pubkeys()) == 3
+    # pubkeys match what was created
+    assert {pk.hex() for pk in store.voting_pubkeys()} == \
+        {c["pubkey"] for c in created}

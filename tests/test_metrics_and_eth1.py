@@ -1,0 +1,129 @@
+"""Metrics registry + scrape endpooint; eth1 deposit tree/cache
+(reference: common/lighthouse_metrics, http_metrics, beacon_node/eth1)."""
+
+import urllib.request
+
+from lighthouse_tpu.common.metrics import (
+    Histogram,
+    MetricsServer,
+    Registry,
+)
+from lighthouse_tpu.eth1 import DepositCache, Eth1Block
+
+
+def test_counter_gauge_histogram_exposition():
+    reg = Registry()
+    c = reg.counter("requests_total", "total requests")
+    c.inc()
+    c.inc(2)
+    g = reg.gauge("queue_len", "queue length")
+    g.set(5)
+    g.dec()
+    h = reg.histogram("verify_seconds", "verify time", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = reg.gather()
+    assert "requests_total 3.0" in text
+    assert "queue_len 4.0" in text
+    assert 'verify_seconds_bucket{le="0.1"} 1' in text
+    assert 'verify_seconds_bucket{le="1.0"} 2' in text
+    assert 'verify_seconds_bucket{le="+Inf"} 3' in text
+    assert "verify_seconds_count 3" in text
+    # same name returns the same metric
+    assert reg.counter("requests_total") is c
+
+
+def test_timer_context():
+    h = Histogram("t", "", buckets=(10.0,))
+    with h.start_timer():
+        pass
+    assert h._total == 1
+
+
+def test_metrics_http_scrape():
+    reg = Registry()
+    reg.counter("up", "").inc()
+    server = MetricsServer(reg).start()
+    try:
+        body = urllib.request.urlopen(server.url + "/metrics").read().decode()
+        assert "up 1.0" in body
+    finally:
+        server.stop()
+
+
+def test_deposit_tree_matches_spec_zero_root():
+    cache = DepositCache()
+    # empty tree root = zero-subtree root mixed with length 0
+    import hashlib
+
+    node = b"\x00" * 32
+    for _ in range(32):
+        node = hashlib.sha256(node + node).digest()
+    expected = hashlib.sha256(node + (0).to_bytes(32, "little")).digest()
+    assert cache.deposit_root() == expected
+
+
+def test_deposit_proofs_verify():
+    import hashlib
+
+    cache = DepositCache()
+    leaves = [bytes([i]) * 32 for i in range(5)]
+    for leaf in leaves:
+        cache.tree.push(leaf)
+
+    root = cache.tree.root()
+    for idx, leaf in enumerate(leaves):
+        proof = cache.tree.proof(idx)
+        assert len(proof) == 33
+        node = leaf
+        pos = idx
+        for sibling in proof[:-1]:
+            if pos & 1:
+                node = hashlib.sha256(sibling + node).digest()
+            else:
+                node = hashlib.sha256(node + sibling).digest()
+            pos //= 2
+        node = hashlib.sha256(node + proof[-1]).digest()
+        assert node == root, f"proof {idx} failed"
+
+
+def test_deposit_proofs_against_snapshot_count():
+    """Proofs must verify against a HISTORICAL deposit_count snapshot, not
+    the cache frontier (the state's eth1_data generally lags the log)."""
+    import hashlib
+
+    cache = DepositCache()
+    leaves = [bytes([i]) * 32 for i in range(10)]
+    for leaf in leaves:
+        cache.tree.push(leaf)
+    snapshot_root = cache.tree.root_at_count(5)
+    assert snapshot_root != cache.tree.root()
+    for idx in range(5):
+        proof = cache.tree.proof(idx, deposit_count=5)
+        node = leaves[idx]
+        pos = idx
+        for sibling in proof[:-1]:
+            if pos & 1:
+                node = hashlib.sha256(sibling + node).digest()
+            else:
+                node = hashlib.sha256(node + sibling).digest()
+            pos //= 2
+        node = hashlib.sha256(node + proof[-1]).digest()
+        assert node == snapshot_root, f"snapshot proof {idx} failed"
+
+
+def test_eth1_data_voting_pick():
+    cache = DepositCache()
+    cache.insert_eth1_block(Eth1Block(1, b"\x01" * 32, 100,
+                                      deposit_root=b"\xaa" * 32,
+                                      deposit_count=3))
+    cache.insert_eth1_block(Eth1Block(2, b"\x02" * 32, 200,
+                                      deposit_root=b"\xbb" * 32,
+                                      deposit_count=4))
+    cache.insert_eth1_block(Eth1Block(3, b"\x03" * 32, 300,
+                                      deposit_root=b"\xcc" * 32,
+                                      deposit_count=5))
+    vote = cache.eth1_data_for_voting(lookahead_timestamp=250)
+    assert vote["block_hash"] == b"\x02" * 32
+    assert cache.eth1_data_for_voting(50) is None
