@@ -109,6 +109,11 @@ class BeaconChain:
         self.observed_aggregators = ObservedAttesters()
         self.observed_aggregates = ObservedItems()
         self.observed_block_producers = ObservedBlockProducers()
+        self.observed_sync_contributors = ObservedAttesters()
+
+        from .sync_committee import SyncContributionPool
+
+        self.sync_contribution_pool = SyncContributionPool(types, spec)
 
         self.head = CanonicalHead(
             block_root=genesis_block_root,
@@ -312,6 +317,27 @@ class BeaconChain:
             )
         return verified
 
+    def process_sync_committee_message(self, message, subnet_id=None):
+        """Gossip sync-committee message: verify + fold into the
+        contribution pool (sync_committee_verification.rs)."""
+        from . import sync_committee as sc
+
+        verified = sc.verify_sync_committee_message(self, message, subnet_id)
+        for pos in sc.current_sync_committee_indices(
+            self, message.validator_index
+        ):
+            self.sync_contribution_pool.insert_message(self, message, pos)
+        return verified
+
+    def process_signed_contribution(self, signed_contribution):
+        from . import sync_committee as sc
+
+        verified = sc.verify_signed_contribution(self, signed_contribution)
+        self.sync_contribution_pool.insert_contribution(
+            signed_contribution.message.contribution
+        )
+        return verified
+
     def apply_attestation_to_fork_choice(self, indexed_att) -> None:
         data = indexed_att.data
         self.fork_choice.on_attestation(
@@ -407,6 +433,11 @@ class BeaconChain:
                 )
 
             proposer = h.get_beacon_proposer_index(state, spec)
+            # Sync aggregate: messages were signed at slot-1 over this
+            # block's parent root (per_block_processing expects exactly that).
+            sync_aggregate = self.sync_contribution_pool.best_sync_aggregate(
+                max(slot, 1) - 1, parent_root
+            )
             body = t.BeaconBlockBodyCapella(
                 randao_reveal=randao_reveal,
                 eth1_data=state.eth1_data,
@@ -415,10 +446,7 @@ class BeaconChain:
                 attester_slashings=attester_slashings,
                 attestations=attestations,
                 voluntary_exits=exits,
-                sync_aggregate=t.SyncAggregate(
-                    sync_committee_bits=[False] * spec.preset.SYNC_COMMITTEE_SIZE,
-                    sync_committee_signature=bls.Signature.infinity().to_bytes(),
-                ),
+                sync_aggregate=sync_aggregate,
                 execution_payload=payload,
                 bls_to_execution_changes=bls_changes,
             )

@@ -1,0 +1,361 @@
+"""Sync-committee verification + contribution pooling.
+
+Mirror of beacon_chain/src/sync_committee_verification.rs and the naive
+sync-contribution pool: gossip `SyncCommitteeMessage`s verify (slot window,
+membership in the CURRENT sync committee, first-seen per slot, signature
+over the head root) and aggregate per (slot, root, subcommittee) into
+contributions; `SignedContributionAndProof` verifies the selection proof +
+envelope + aggregate (the altair analog of the 3-set aggregate path);
+`best_sync_aggregate` assembles the block's SyncAggregate.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from lighthouse_tpu.crypto.bls import api as bls
+from lighthouse_tpu.state_transition import signature_sets as sigsets
+from lighthouse_tpu.types.spec import (
+    DOMAIN_CONTRIBUTION_AND_PROOF,
+    DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+    compute_signing_root,
+)
+
+SYNC_COMMITTEE_SUBNET_COUNT = 4
+
+
+class SyncCommitteeError(Exception):
+    def __init__(self, kind: str, detail: str = ""):
+        self.kind = kind
+        super().__init__(f"{kind}{': ' + detail if detail else ''}")
+
+
+@dataclass
+class VerifiedSyncCommitteeMessage:
+    message: object
+    subnet_id: int
+
+
+def current_sync_committee_indices(chain, validator_index: int) -> List[int]:
+    """Positions of `validator_index` in the current sync committee (a
+    validator may appear multiple times)."""
+    state = chain.head_state_for_signatures()
+    pk = chain.pubkey_cache.get(validator_index)
+    if pk is None:
+        return []
+    pk_bytes = pk.to_bytes()
+    return [
+        i for i, key in enumerate(state.current_sync_committee.pubkeys)
+        if bytes(key) == pk_bytes
+    ]
+
+
+def verify_sync_committee_message(
+    chain, message, subnet_id: Optional[int] = None
+) -> VerifiedSyncCommitteeMessage:
+    current = chain.current_slot()
+    if not (current - 1 <= message.slot <= current):
+        raise SyncCommitteeError("InvalidSlot", f"{message.slot} vs {current}")
+    positions = current_sync_committee_indices(chain, message.validator_index)
+    if not positions:
+        raise SyncCommitteeError(
+            "NotInSyncCommittee", str(message.validator_index)
+        )
+    if chain.observed_sync_contributors.is_known(
+        message.slot, message.validator_index
+    ):
+        raise SyncCommitteeError("PriorMessageKnown")
+
+    state = chain.head_state_for_signatures()
+    sset = sigsets.sync_committee_message_set(
+        state, chain.types, chain.spec, message.slot,
+        bytes(message.beacon_block_root), message.validator_index,
+        bytes(message.signature), chain.pubkey_getter,
+    )
+    if not bls.verify_signature_sets([sset], backend=chain.bls_backend):
+        raise SyncCommitteeError("InvalidSignature")
+    # First-seen is recorded only AFTER the signature verifies: a garbage
+    # message must not lock the real validator out of its slot.
+    if chain.observed_sync_contributors.observe(
+        message.slot, message.validator_index
+    ):
+        raise SyncCommitteeError("PriorMessageKnown")
+    subcommittee_size = (
+        chain.spec.preset.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+    )
+    subnet = positions[0] // subcommittee_size if subnet_id is None else subnet_id
+    return VerifiedSyncCommitteeMessage(message=message, subnet_id=subnet)
+
+
+def batch_verify_sync_committee_messages(
+    chain, messages: List[object]
+) -> List[object]:
+    """ONE backend call for a batch of gossip sync messages, per-item
+    fallback on poison (the sync analog of attestation batch.rs). Results
+    align with inputs: VerifiedSyncCommitteeMessage or SyncCommitteeError."""
+    results: List[object] = [None] * len(messages)
+    staged = []
+    state = chain.head_state_for_signatures()
+    current = chain.current_slot()
+    sub_size = (
+        chain.spec.preset.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+    )
+    in_batch = set()
+    for i, message in enumerate(messages):
+        try:
+            if not (current - 1 <= message.slot <= current):
+                raise SyncCommitteeError("InvalidSlot")
+            positions = current_sync_committee_indices(
+                chain, message.validator_index
+            )
+            if not positions:
+                raise SyncCommitteeError(
+                    "NotInSyncCommittee", str(message.validator_index)
+                )
+            key = (message.slot, message.validator_index)
+            if key in in_batch or chain.observed_sync_contributors.is_known(
+                message.slot, message.validator_index
+            ):
+                raise SyncCommitteeError("PriorMessageKnown")
+            in_batch.add(key)
+            sset = sigsets.sync_committee_message_set(
+                state, chain.types, chain.spec, message.slot,
+                bytes(message.beacon_block_root), message.validator_index,
+                bytes(message.signature), chain.pubkey_getter,
+            )
+            staged.append((i, positions, sset))
+        except SyncCommitteeError as e:
+            results[i] = e
+
+    if staged:
+        sets = [s for _, _, s in staged]
+        ok = bls.verify_signature_sets(sets, backend=chain.bls_backend)
+        for i, positions, sset in staged:
+            item_ok = ok or bls.verify_signature_sets(
+                [sset], backend=chain.bls_backend
+            )
+            if item_ok:
+                # Observe only what verified (see the single-item path).
+                chain.observed_sync_contributors.observe(
+                    messages[i].slot, messages[i].validator_index
+                )
+                results[i] = VerifiedSyncCommitteeMessage(
+                    message=messages[i],
+                    subnet_id=positions[0] // sub_size,
+                )
+            else:
+                results[i] = SyncCommitteeError("InvalidSignature")
+    return results
+
+
+def is_sync_committee_aggregator(preset, selection_proof: bytes) -> bool:
+    """spec is_sync_committee_aggregator — the ONE definition both the node
+    (gossip check) and the validator client (duty check) use."""
+    modulo = max(
+        1, preset.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT //
+        preset.TARGET_AGGREGATORS_PER_COMMITTEE,
+    )
+    digest = hashlib.sha256(bytes(selection_proof)).digest()
+    return int.from_bytes(digest[:8], "little") % modulo == 0
+
+
+def is_sync_aggregator(chain, selection_proof: bytes) -> bool:
+    return is_sync_committee_aggregator(chain.spec.preset, selection_proof)
+
+
+def verify_signed_contribution(chain, signed_contribution) -> object:
+    """SignedContributionAndProof: selection proof + envelope + aggregate
+    (sync_committee_verification.rs contribution path)."""
+    from lighthouse_tpu.types import ssz
+    from lighthouse_tpu.types.spec import get_domain
+
+    msg = signed_contribution.message
+    contribution = msg.contribution
+    current = chain.current_slot()
+    if not (current - 1 <= contribution.slot <= current):
+        raise SyncCommitteeError("InvalidSlot")
+    if contribution.subcommittee_index >= SYNC_COMMITTEE_SUBNET_COUNT:
+        raise SyncCommitteeError("InvalidSubcommittee")
+    if chain.pubkey_getter(msg.aggregator_index) is None:
+        raise SyncCommitteeError("UnknownValidator", str(msg.aggregator_index))
+    # The aggregator must be a member of the subcommittee it aggregates for.
+    sub_size_check = (
+        chain.spec.preset.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+    )
+    agg_positions = current_sync_committee_indices(chain, msg.aggregator_index)
+    if not any(p // sub_size_check == contribution.subcommittee_index
+               for p in agg_positions):
+        raise SyncCommitteeError("AggregatorNotInSubcommittee")
+    if not is_sync_aggregator(chain, msg.selection_proof):
+        raise SyncCommitteeError("InvalidSelectionProof", "not selected")
+
+    state = chain.head_state_for_signatures()
+    t, spec = chain.types, chain.spec
+    epoch = spec.epoch_at_slot(contribution.slot)
+
+    def _domain(domain_type):
+        return get_domain(
+            spec, domain_type, epoch,
+            state.fork.current_version, state.fork.previous_version,
+            state.fork.epoch, state.genesis_validators_root,
+        )
+
+    # 1. selection proof over SyncAggregatorSelectionData
+    sel_data = t.SyncAggregatorSelectionData(
+        slot=contribution.slot,
+        subcommittee_index=contribution.subcommittee_index,
+    )
+    sel_root = compute_signing_root(
+        sel_data, t.SyncAggregatorSelectionData,
+        _domain(DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF),
+    )
+    sets = [bls.SignatureSet(
+        signature=bls.Signature.from_bytes(bytes(msg.selection_proof)),
+        signing_keys=[chain.pubkey_getter(msg.aggregator_index)],
+        message=sel_root,
+    )]
+    # 2. envelope over ContributionAndProof
+    env_root = compute_signing_root(
+        msg, t.ContributionAndProof, _domain(DOMAIN_CONTRIBUTION_AND_PROOF)
+    )
+    sets.append(bls.SignatureSet(
+        signature=bls.Signature.from_bytes(bytes(signed_contribution.signature)),
+        signing_keys=[chain.pubkey_getter(msg.aggregator_index)],
+        message=env_root,
+    ))
+    # 3. the aggregate itself: participants from the subcommittee bits
+    subcommittee_size = (
+        spec.preset.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+    )
+    base = contribution.subcommittee_index * subcommittee_size
+    participant_pks = [
+        bls.PublicKey.from_bytes(bytes(
+            state.current_sync_committee.pubkeys[base + i]
+        ))
+        for i, bit in enumerate(contribution.aggregation_bits) if bit
+    ]
+    if participant_pks:
+        sset = sigsets.sync_committee_message_set(
+            state, t, spec, contribution.slot,
+            bytes(contribution.beacon_block_root), 0,
+            bytes(contribution.signature), lambda _i: participant_pks[0],
+        )
+        # patch in the full key set (the constructor signs for one index)
+        sets.append(bls.SignatureSet(
+            signature=sset.signature,
+            signing_keys=participant_pks,
+            message=sset.message,
+        ))
+    if not bls.verify_signature_sets(sets, backend=chain.bls_backend):
+        raise SyncCommitteeError("InvalidSignature")
+    return signed_contribution
+
+
+class SyncContributionPool:
+    """(slot, root, subcommittee) -> aggregated contribution; assembles the
+    block SyncAggregate (naive_aggregation_pool for sync + op pool
+    get_sync_aggregate)."""
+
+    def __init__(self, types, spec):
+        self.types = types
+        self.spec = spec
+        self._lock = threading.Lock()
+        # (slot, root, subcommittee) -> (bits tuple, signature point list)
+        self._contribs: Dict[Tuple[int, bytes, int], Tuple[tuple, object]] = {}
+
+    def insert_message(self, chain, message, position: int) -> None:
+        """Fold one verified SyncCommitteeMessage at committee `position`."""
+        P = self.spec.preset
+        sub_size = P.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+        sub = position // sub_size
+        bit = position % sub_size
+        key = (message.slot, bytes(message.beacon_block_root), sub)
+        with self._lock:
+            bits, agg = self._contribs.get(
+                key, ((False,) * sub_size, None)
+            )
+            if bits[bit]:
+                return
+            new_bits = tuple(
+                b or (i == bit) for i, b in enumerate(bits)
+            )
+            sig = bls.Signature.from_bytes(bytes(message.signature))
+            if agg is None:
+                new_agg = sig
+            else:
+                merged = bls.AggregateSignature.aggregate([agg, sig])
+                new_agg = bls.Signature(point=merged.point,
+                                        subgroup_checked=True)
+            self._contribs[key] = (new_bits, new_agg)
+
+    def get_contribution(self, slot: int, root: bytes, subcommittee: int):
+        with self._lock:
+            hit = self._contribs.get((slot, bytes(root), subcommittee))
+        if hit is None:
+            return None
+        bits, agg = hit
+        return self.types.SyncCommitteeContribution(
+            slot=slot,
+            beacon_block_root=root,
+            subcommittee_index=subcommittee,
+            aggregation_bits=list(bits),
+            signature=agg.to_bytes(),
+        )
+
+    def insert_contribution(self, contribution) -> None:
+        """Fold a whole verified contribution (from gossip aggregators)."""
+        key = (contribution.slot, bytes(contribution.beacon_block_root),
+               contribution.subcommittee_index)
+        incoming_bits = tuple(bool(b) for b in contribution.aggregation_bits)
+        sig = bls.Signature.from_bytes(bytes(contribution.signature))
+        with self._lock:
+            bits, agg = self._contribs.get(
+                key, ((False,) * len(incoming_bits), None)
+            )
+            overlap = any(a and b for a, b in zip(bits, incoming_bits))
+            if agg is None:
+                self._contribs[key] = (incoming_bits, sig)
+            elif not overlap:
+                merged = bls.AggregateSignature.aggregate([agg, sig])
+                self._contribs[key] = (
+                    tuple(a or b for a, b in zip(bits, incoming_bits)),
+                    bls.Signature(point=merged.point, subgroup_checked=True),
+                )
+            elif sum(incoming_bits) > sum(bits):
+                self._contribs[key] = (incoming_bits, sig)
+
+    def best_sync_aggregate(self, slot: int, root: bytes):
+        """Assemble the block's SyncAggregate from per-subcommittee
+        contributions for (slot, root)."""
+        P = self.spec.preset
+        sub_size = P.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+        all_bits = []
+        sigs = []
+        for sub in range(SYNC_COMMITTEE_SUBNET_COUNT):
+            c = self.get_contribution(slot, root, sub)
+            if c is None:
+                all_bits.extend([False] * sub_size)
+            else:
+                all_bits.extend(bool(b) for b in c.aggregation_bits)
+                sigs.append(bls.Signature.from_bytes(bytes(c.signature)))
+        if sigs:
+            merged = bls.AggregateSignature.aggregate(sigs)
+            sig_bytes = bls.Signature(
+                point=merged.point, subgroup_checked=True
+            ).to_bytes()
+        else:
+            sig_bytes = bls.Signature.infinity().to_bytes()
+        return self.types.SyncAggregate(
+            sync_committee_bits=all_bits,
+            sync_committee_signature=sig_bytes,
+        )
+
+    def prune(self, current_slot: int) -> None:
+        with self._lock:
+            self._contribs = {
+                k: v for k, v in self._contribs.items()
+                if k[0] + 2 >= current_slot
+            }

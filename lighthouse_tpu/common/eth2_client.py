@@ -18,7 +18,7 @@ class Eth2ClientError(Exception):
 
 
 class BeaconNodeHttpClient:
-    def __init__(self, base_url: str, timeout: float = 10.0):
+    def __init__(self, base_url: str, timeout: float = 30.0):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
 
@@ -107,3 +107,29 @@ class BeaconNodeHttpClient:
             "slot": str(slot),
             "attestation_data_root": "0x" + data_root.hex(),
         })["data"]
+
+    def get_head_header(self) -> Dict[str, Any]:
+        return self._get("/eth/v1/beacon/headers/head")["data"]
+
+    def post_sync_duties(self, epoch: int,
+                         indices: List[int]) -> List[Dict[str, Any]]:
+        return self._post(
+            f"/eth/v1/validator/duties/sync/{epoch}",
+            [str(i) for i in indices],
+        )["data"]
+
+    def submit_sync_messages(self, msgs_json: List[Dict[str, Any]]) -> None:
+        self._post("/eth/v1/beacon/pool/sync_committees", msgs_json)
+
+    def get_sync_contribution(self, slot: int, subcommittee_index: int,
+                              block_root: bytes) -> Dict[str, Any]:
+        return self._get("/eth/v1/validator/sync_committee_contribution", {
+            "slot": str(slot),
+            "subcommittee_index": str(subcommittee_index),
+            "beacon_block_root": "0x" + block_root.hex(),
+        })["data"]
+
+    def submit_contribution_and_proofs(
+        self, contribs_json: List[Dict[str, Any]]
+    ) -> None:
+        self._post("/eth/v1/validator/contribution_and_proofs", contribs_json)

@@ -194,6 +194,42 @@ class BeaconApiServer:
                 "validator": to_json(chain.types.Validator, v),
             }}
 
+        m = re.fullmatch(r"/eth/v1/beacon/headers/([^/]+)", path)
+        if m:
+            if m.group(1) == "head":
+                # Always available, even at genesis (no stored block yet).
+                state = chain.head.state
+                hdr = state.latest_block_header.copy()
+                if bytes(hdr.state_root) == b"\x00" * 32:
+                    fork = chain.fork_at(state.slot)
+                    hdr.state_root = t.BeaconState[fork].hash_tree_root(state)
+                return {"data": {
+                    "root": "0x" + chain.head.block_root.hex(),
+                    "canonical": True,
+                    "header": {
+                        "message": to_json(t.BeaconBlockHeader, hdr),
+                        "signature": "0x" + b"\x00".hex() * 96,
+                    },
+                }}
+            signed = self._block_by_id(m.group(1))
+            fork = chain.fork_at(signed.message.slot)
+            root = t.BeaconBlock[fork].hash_tree_root(signed.message)
+            hdr = t.BeaconBlockHeader(
+                slot=signed.message.slot,
+                proposer_index=signed.message.proposer_index,
+                parent_root=signed.message.parent_root,
+                state_root=signed.message.state_root,
+                body_root=type(signed.message.body).hash_tree_root(
+                    signed.message.body
+                ),
+            )
+            return {"data": {
+                "root": "0x" + root.hex(),
+                "canonical": True,
+                "header": {"message": to_json(t.BeaconBlockHeader, hdr),
+                           "signature": "0x" + bytes(signed.signature).hex()},
+            }}
+
         m = re.fullmatch(r"/eth/v2/beacon/blocks/([^/]+)", path)
         if m:
             signed = self._block_by_id(m.group(1))
@@ -250,8 +286,102 @@ class BeaconApiServer:
         if path == "/eth/v1/validator/beacon_committee_subscriptions" and \
                 method == "POST":
             return {}
+        if path == "/eth/v1/validator/sync_committee_subscriptions" and \
+                method == "POST":
+            return {}
+
+        if path == "/eth/v1/beacon/pool/sync_committees" and method == "POST":
+            return self._submit_sync_messages(body)
+
+        m = re.fullmatch(r"/eth/v1/validator/duties/sync/(\d+)", path)
+        if m and method == "POST":
+            return self._sync_duties(int(m.group(1)), [int(i) for i in body])
+
+        if path == "/eth/v1/validator/sync_committee_contribution":
+            slot = int(query["slot"][0])
+            sub = int(query["subcommittee_index"][0])
+            root = bytes.fromhex(query["beacon_block_root"][0][2:])
+            c = chain.sync_contribution_pool.get_contribution(slot, root, sub)
+            if c is None:
+                raise ApiError(404, "no contribution")
+            return {"data": to_json(t.SyncCommitteeContribution, c)}
+
+        if path == "/eth/v1/validator/contribution_and_proofs" and \
+                method == "POST":
+            return self._submit_contributions(body)
 
         raise ApiError(404, f"unknown route {method} {path}")
+
+    def _submit_sync_messages(self, body) -> Dict[str, Any]:
+        """Batch endpoint: one backend verification call for the whole
+        submission (the sync analog of the attestation batch choke point)."""
+        from lighthouse_tpu.beacon_chain import sync_committee as sc
+
+        chain = self.chain
+        t = chain.types
+        msgs = [from_json(t.SyncCommitteeMessage, obj) for obj in body]
+        results = sc.batch_verify_sync_committee_messages(chain, msgs)
+        failures = []
+        for i, r in enumerate(results):
+            if isinstance(r, sc.VerifiedSyncCommitteeMessage):
+                for pos in sc.current_sync_committee_indices(
+                    chain, msgs[i].validator_index
+                ):
+                    chain.sync_contribution_pool.insert_message(
+                        chain, msgs[i], pos
+                    )
+            elif isinstance(r, sc.SyncCommitteeError) and \
+                    r.kind != "PriorMessageKnown":
+                failures.append({"index": i, "message": str(r)})
+        if failures:
+            raise ApiError(400, json.dumps(failures))
+        return {}
+
+    def _submit_contributions(self, body) -> Dict[str, Any]:
+        from lighthouse_tpu.beacon_chain.sync_committee import (
+            SyncCommitteeError,
+        )
+
+        t = self.chain.types
+        failures = []
+        for i, obj in enumerate(body):
+            try:
+                sc = from_json(t.SignedContributionAndProof, obj)
+                self.chain.process_signed_contribution(sc)
+            except SyncCommitteeError as e:
+                failures.append({"index": i, "message": str(e)})
+            except Exception as e:
+                # Malformed input (bad points, unknown indices) is the
+                # submitter's fault: 400 per item, never a 500.
+                failures.append({"index": i, "message": repr(e)})
+        if failures:
+            raise ApiError(400, json.dumps(failures))
+        return {}
+
+    def _sync_duties(self, epoch: int, indices: List[int]) -> Dict[str, Any]:
+        from lighthouse_tpu.beacon_chain import sync_committee as sc
+
+        chain = self.chain
+        # Only the CURRENT sync-committee period is served (the state's
+        # next_sync_committee would cover period+1; beyond that is unknowable).
+        per = chain.spec.preset.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+        current_epoch = chain.spec.epoch_at_slot(chain.current_slot())
+        if epoch // per != current_epoch // per:
+            raise ApiError(
+                400, f"epoch {epoch} outside the current sync-committee period"
+            )
+        duties = []
+        for idx in indices:
+            positions = sc.current_sync_committee_indices(chain, idx)
+            if positions:
+                pk = chain.pubkey_cache.get(idx)
+                duties.append({
+                    "pubkey": "0x" + pk.to_bytes().hex() if pk else "0x",
+                    "validator_index": str(idx),
+                    "validator_sync_committee_indices":
+                        [str(p) for p in positions],
+                })
+        return {"data": duties}
 
     # -------------------------------------------------------------- helpers
 

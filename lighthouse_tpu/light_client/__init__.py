@@ -1,0 +1,20 @@
+"""Light-client protocol (reference: the LightClientBootstrap Req/Resp
+protocol + beacon_chain light-client server paths)."""
+
+from .light_client import (
+    LightClientBootstrap,
+    LightClientError,
+    LightClientStore,
+    LightClientUpdate,
+    create_bootstrap,
+    create_optimistic_update,
+)
+
+__all__ = [
+    "LightClientBootstrap",
+    "LightClientError",
+    "LightClientStore",
+    "LightClientUpdate",
+    "create_bootstrap",
+    "create_optimistic_update",
+]

@@ -1,0 +1,172 @@
+"""Light client: trust-minimized chain following via sync committees.
+
+Server side (the node): `create_bootstrap` packages a trusted block's
+header + the state's current_sync_committee + a Merkle branch proving it
+against the header's state_root (the LightClientBootstrap Req/Resp payload,
+rpc/protocol.rs:177); `create_optimistic_update` packages a block's
+embedded SyncAggregate as an attestation of its parent header.
+
+Client side: `LightClientStore` verifies the bootstrap proof against a
+trusted root, then follows optimistic updates by checking ≥2/3 sync
+participation + the aggregate BLS signature over the attested header under
+DOMAIN_SYNC_COMMITTEE.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+from lighthouse_tpu.crypto.bls import api as bls
+from lighthouse_tpu.types import ssz
+from lighthouse_tpu.types.spec import (
+    DOMAIN_SYNC_COMMITTEE,
+    compute_signing_root,
+    get_domain,
+)
+
+
+class LightClientError(Exception):
+    pass
+
+
+@dataclass
+class LightClientBootstrap:
+    header: object                      # BeaconBlockHeader
+    current_sync_committee: object      # SyncCommittee
+    proof_index: int
+    proof_branch: List[bytes]
+
+
+@dataclass
+class LightClientUpdate:
+    attested_header: object             # header the committee signed
+    sync_aggregate: object
+    signature_slot: int
+
+
+# ---------------------------------------------------------------- server
+
+
+def _header_of_block(types, signed_block):
+    msg = signed_block.message
+    return types.BeaconBlockHeader(
+        slot=msg.slot,
+        proposer_index=msg.proposer_index,
+        parent_root=msg.parent_root,
+        state_root=msg.state_root,
+        body_root=type(msg.body).hash_tree_root(msg.body),
+    )
+
+
+def create_bootstrap(chain, block_root: bytes) -> LightClientBootstrap:
+    """Bootstrap anchored at `block_root` (must be in the store)."""
+    signed = chain.store.get_block(block_root)
+    if signed is None:
+        raise LightClientError("unknown block")
+    state = chain.store.get_state(bytes(signed.message.state_root))
+    if state is None:
+        raise LightClientError("state unavailable")
+    fork = chain.fork_at(signed.message.slot)
+    cls = chain.types.BeaconState[fork]
+    index, leaf, branch = ssz.container_field_proof(
+        cls, state, "current_sync_committee"
+    )
+    return LightClientBootstrap(
+        header=_header_of_block(chain.types, signed),
+        current_sync_committee=state.current_sync_committee,
+        proof_index=index,
+        proof_branch=branch,
+    )
+
+
+def create_optimistic_update(chain, block_root: bytes) -> LightClientUpdate:
+    """The block's SyncAggregate attests its PARENT header."""
+    signed = chain.store.get_block(block_root)
+    if signed is None:
+        raise LightClientError("unknown block")
+    parent = chain.store.get_block(bytes(signed.message.parent_root))
+    if parent is None:
+        raise LightClientError("parent unavailable")
+    return LightClientUpdate(
+        attested_header=_header_of_block(chain.types, parent),
+        sync_aggregate=signed.message.body.sync_aggregate,
+        signature_slot=signed.message.slot,
+    )
+
+
+# ---------------------------------------------------------------- client
+
+
+class LightClientStore:
+    def __init__(self, types, spec, trusted_block_root: bytes,
+                 genesis_validators_root: bytes, fork_version: bytes,
+                 fork: str = "capella"):
+        self.types = types
+        self.spec = spec
+        self.trusted_block_root = trusted_block_root
+        self.genesis_validators_root = genesis_validators_root
+        self.fork_version = fork_version
+        self.fork = fork
+        self.finalized_header = None
+        self.optimistic_header = None
+        self.current_sync_committee = None
+
+    def process_bootstrap(self, bootstrap: LightClientBootstrap) -> None:
+        t = self.types
+        header_root = t.BeaconBlockHeader.hash_tree_root(bootstrap.header)
+        if header_root != self.trusted_block_root:
+            raise LightClientError("bootstrap header != trusted root")
+        # The field index is a CLIENT-side constant (the spec's
+        # CURRENT_SYNC_COMMITTEE_INDEX): a server-supplied index could prove
+        # a different (attacker-chosen) committee field instead.
+        state_cls = t.BeaconState[self.fork]
+        expected_index = [f for f, _ in state_cls._ssz_fields].index(
+            "current_sync_committee"
+        )
+        if bootstrap.proof_index != expected_index:
+            raise LightClientError("bootstrap proof index mismatch")
+        leaf = t.SyncCommittee.hash_tree_root(bootstrap.current_sync_committee)
+        ok = ssz.verify_field_proof(
+            bytes(bootstrap.header.state_root), leaf,
+            bootstrap.proof_branch, bootstrap.proof_index,
+        )
+        if not ok:
+            raise LightClientError("sync committee proof invalid")
+        self.finalized_header = bootstrap.header
+        self.optimistic_header = bootstrap.header
+        self.current_sync_committee = bootstrap.current_sync_committee
+
+    def process_optimistic_update(self, update: LightClientUpdate) -> None:
+        if self.current_sync_committee is None:
+            raise LightClientError("not bootstrapped")
+        t, spec = self.types, self.spec
+        bits = list(update.sync_aggregate.sync_committee_bits)
+        participation = sum(1 for b in bits if b)
+        if participation * 3 < len(bits) * 2:
+            raise LightClientError(
+                f"insufficient participation {participation}/{len(bits)}"
+            )
+        # signature over the attested header root at epoch(signature_slot-1)
+        prev_slot = max(update.signature_slot, 1) - 1
+        domain = get_domain(
+            spec, DOMAIN_SYNC_COMMITTEE, spec.epoch_at_slot(prev_slot),
+            self.fork_version, self.fork_version, 0,
+            self.genesis_validators_root,
+        )
+        root = t.BeaconBlockHeader.hash_tree_root(update.attested_header)
+        signing_root = compute_signing_root(root, ssz.Bytes32, domain)
+        pubkeys = [
+            bls.PublicKey.from_bytes(bytes(pk))
+            for pk, bit in zip(
+                self.current_sync_committee.pubkeys, bits
+            ) if bit
+        ]
+        sig = bls.Signature.from_bytes(
+            bytes(update.sync_aggregate.sync_committee_signature)
+        )
+        if not bls.fast_aggregate_verify(pubkeys, signing_root, sig):
+            raise LightClientError("sync aggregate signature invalid")
+        if self.optimistic_header is None or \
+                update.attested_header.slot > self.optimistic_header.slot:
+            self.optimistic_header = update.attested_header

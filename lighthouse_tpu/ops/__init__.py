@@ -51,5 +51,33 @@ try:
                                  *args, **kwargs)
 
     _compiler._cache_write = _bounded_cache_write
+
+    # Atomic cache writes: jax's LRUCache.put writes bytes straight to the
+    # final path, so a concurrent process can read a torn multi-MB entry and
+    # segfault deserializing it. Temp-file + os.replace closes the window.
+    try:
+        from jax._src import lru_cache as _lru
+
+        _orig_put = _lru.LRUCache.put
+
+        def _atomic_put(self, key, val):
+            if not key:
+                raise ValueError("key cannot be empty")
+            cache_path = self.path / f"{key}{_lru._CACHE_SUFFIX}"
+            if cache_path.exists():
+                return
+            tmp = cache_path.with_suffix(cache_path.suffix + f".tmp{os.getpid()}")
+            try:
+                tmp.write_bytes(val)
+                os.replace(tmp, cache_path)
+            except OSError:
+                try:
+                    tmp.unlink()
+                except OSError:
+                    pass
+
+        _lru.LRUCache.put = _atomic_put
+    except Exception:  # pragma: no cover - hardening only
+        pass
 except Exception:  # pragma: no cover - cache is an optimization only
     pass

@@ -651,3 +651,40 @@ def deserialize(typ, data: bytes):
 
 def hash_tree_root(typ, value) -> bytes:
     return typ.hash_tree_root(value)
+
+
+# ---------------------------------------------------------------------------
+# Merkle field proofs (container-level; the light-client protocol's branch
+# material — the reference derives these via tree_hash generalized indices)
+# ---------------------------------------------------------------------------
+
+
+def container_field_proof(cls, value, field_name: str):
+    """-> (field_index, leaf_root, branch) proving `field_name`'s subtree
+    root against cls.hash_tree_root(value). Branch depth =
+    log2(next_pow2(len(fields)))."""
+    fields = cls._ssz_fields
+    names = [f for f, _ in fields]
+    index = names.index(field_name)
+    chunks = [t.hash_tree_root(getattr(value, f)) for f, t in fields]
+    limit = 1
+    while limit < len(chunks):
+        limit *= 2
+    layer = chunks + [ZERO_CHUNK] * (limit - len(chunks))
+    branch = []
+    idx = index
+    while len(layer) > 1:
+        branch.append(layer[idx ^ 1])
+        layer = [_sha(layer[i], layer[i + 1]) for i in range(0, len(layer), 2)]
+        idx //= 2
+    return index, chunks[index], branch
+
+
+def verify_field_proof(root: bytes, leaf: bytes, branch, index: int) -> bool:
+    node = leaf
+    for h, sibling in enumerate(branch):
+        if (index >> h) & 1:
+            node = _sha(sibling, node)
+        else:
+            node = _sha(node, sibling)
+    return node == root

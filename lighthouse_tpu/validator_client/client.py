@@ -57,6 +57,7 @@ class ValidatorClient:
         self._started_epoch: Optional[int] = None
         self.attester_duties: Dict[int, List[dict]] = {}   # epoch -> duties
         self.proposer_duties: Dict[int, List[dict]] = {}
+        self.sync_duties: Dict[int, List[dict]] = {}
         self._fork_info: Optional[dict] = None
         # produced attestations awaiting aggregation: slot -> list of dicts
         self._own_attestations: Dict[int, List[dict]] = {}
@@ -109,12 +110,16 @@ class ValidatorClient:
         epoch = self.spec.epoch_at_slot(slot)
         if epoch not in self.attester_duties:
             self.poll_duties(epoch)
-        stats = {"blocks": 0, "attestations": 0, "aggregates": 0}
+        stats = {"blocks": 0, "attestations": 0, "aggregates": 0,
+                 "sync_messages": 0, "sync_contributions": 0}
         if not self.doppelganger_safe(epoch):
             return stats
         stats["blocks"] = self._block_duty(slot)
         stats["attestations"] = self._attestation_duty(slot)
         stats["aggregates"] = self._aggregate_duty(slot)
+        sm, sc = self._sync_committee_duty(slot)
+        stats["sync_messages"] = sm
+        stats["sync_contributions"] = sc
         return stats
 
     # ---------------------------------------------------------------- block
@@ -188,6 +193,110 @@ class ValidatorClient:
         if submitted:
             self.bn.call(lambda c: c.submit_attestations(submitted))
         return len(submitted)
+
+    # --------------------------------------------------------- sync committee
+
+    def _sync_committee_duty(self, slot: int):
+        """SyncCommitteeService: members sign the head root each slot; the
+        selected aggregators publish contributions
+        (sync_committee_service.rs)."""
+        epoch = self.spec.epoch_at_slot(slot)
+        if epoch not in self.sync_duties:
+            indices = [
+                i for i in (
+                    self.store.index_of(pk)
+                    for pk in self.store.voting_pubkeys()
+                ) if i is not None
+            ]
+            try:
+                self.sync_duties[epoch] = self.bn.call(
+                    lambda c: c.post_sync_duties(epoch, indices)
+                )
+            except Exception:
+                return 0, 0  # transient BN error: retry next slot, don't cache
+        duties = self.sync_duties[epoch]
+        if not duties:
+            return 0, 0
+        fork_info = self._ensure_fork_info()
+        header = self.bn.call(lambda c: c.get_head_header())
+        head_root = bytes.fromhex(header["root"][2:])
+        own = {pk.hex(): pk for pk in self.store.voting_pubkeys()}
+
+        msgs = []
+        for duty in duties:
+            pk = own.get(duty["pubkey"][2:])
+            if pk is None:
+                continue
+            sig = self.store.sign_sync_committee_message(
+                pk, slot, head_root, fork_info
+            )
+            msgs.append(to_json(
+                self.types.SyncCommitteeMessage,
+                self.types.SyncCommitteeMessage(
+                    slot=slot, beacon_block_root=head_root,
+                    validator_index=int(duty["validator_index"]),
+                    signature=sig,
+                ),
+            ))
+        if msgs:
+            self.bn.call(lambda c: c.submit_sync_messages(msgs))
+
+        # Aggregation phase (slot + 2/3): selected per subcommittee.
+        from lighthouse_tpu.beacon_chain.sync_committee import (
+            SYNC_COMMITTEE_SUBNET_COUNT,
+            is_sync_committee_aggregator,
+        )
+
+        P = self.spec.preset
+        sub_size = P.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+        contribs = []
+        done_subs = set()
+        for duty in duties:
+            pk = own.get(duty["pubkey"][2:])
+            if pk is None:
+                continue
+            subs = {
+                int(p) // sub_size
+                for p in duty["validator_sync_committee_indices"]
+            }
+            for sub in subs - done_subs:
+                proof = self.store.sign_sync_selection_proof(
+                    pk, slot, sub, fork_info
+                )
+                if not is_sync_committee_aggregator(P, proof):
+                    continue
+                try:
+                    cjson = self.bn.call(
+                        lambda c: c.get_sync_contribution(slot, sub, head_root)
+                    )
+                except Eth2ClientError:
+                    continue
+                contribution = from_json(
+                    self.types.SyncCommitteeContribution, cjson
+                )
+                msg = self.types.ContributionAndProof(
+                    aggregator_index=int(duty["validator_index"]),
+                    contribution=contribution,
+                    selection_proof=proof,
+                )
+                sig = self.store.sign_contribution_and_proof(
+                    pk, msg, fork_info
+                )
+                contribs.append(to_json(
+                    self.types.SignedContributionAndProof,
+                    self.types.SignedContributionAndProof(
+                        message=msg, signature=sig
+                    ),
+                ))
+                done_subs.add(sub)
+        if contribs:
+            try:
+                self.bn.call(
+                    lambda c: c.submit_contribution_and_proofs(contribs)
+                )
+            except Eth2ClientError:
+                return len(msgs), 0
+        return len(msgs), len(contribs)
 
     # ------------------------------------------------------------- aggregate
 

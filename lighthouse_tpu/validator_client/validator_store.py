@@ -16,8 +16,11 @@ from lighthouse_tpu.types.spec import (
     DOMAIN_AGGREGATE_AND_PROOF,
     DOMAIN_BEACON_ATTESTER,
     DOMAIN_BEACON_PROPOSER,
+    DOMAIN_CONTRIBUTION_AND_PROOF,
     DOMAIN_RANDAO,
     DOMAIN_SELECTION_PROOF,
+    DOMAIN_SYNC_COMMITTEE,
+    DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
     compute_signing_root,
     get_domain,
 )
@@ -122,4 +125,35 @@ class ValidatorStore:
         root = compute_signing_root(
             msg, self.types.AggregateAndProof, domain
         )
+        return self._signers[pubkey](root)
+
+    def sign_sync_committee_message(self, pubkey: bytes, slot: int,
+                                    block_root: bytes, fork_info) -> bytes:
+        domain = self._domain(
+            fork_info, DOMAIN_SYNC_COMMITTEE, self.spec.epoch_at_slot(slot)
+        )
+        root = compute_signing_root(block_root, ssz.Bytes32, domain)
+        return self._signers[pubkey](root)
+
+    def sign_sync_selection_proof(self, pubkey: bytes, slot: int,
+                                  subcommittee_index: int, fork_info) -> bytes:
+        data = self.types.SyncAggregatorSelectionData(
+            slot=slot, subcommittee_index=subcommittee_index
+        )
+        domain = self._domain(
+            fork_info, DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+            self.spec.epoch_at_slot(slot),
+        )
+        root = compute_signing_root(
+            data, self.types.SyncAggregatorSelectionData, domain
+        )
+        return self._signers[pubkey](root)
+
+    def sign_contribution_and_proof(self, pubkey: bytes, msg, fork_info) -> bytes:
+        slot = msg.contribution.slot
+        domain = self._domain(
+            fork_info, DOMAIN_CONTRIBUTION_AND_PROOF,
+            self.spec.epoch_at_slot(slot),
+        )
+        root = compute_signing_root(msg, self.types.ContributionAndProof, domain)
         return self._signers[pubkey](root)

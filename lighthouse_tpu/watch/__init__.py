@@ -1,0 +1,6 @@
+"""Chain-monitoring daemon (reference: watch/ — Postgres there, SQLite
+here; same updater/database/server split)."""
+
+from .watch import WatchDB, WatchUpdater
+
+__all__ = ["WatchDB", "WatchUpdater"]

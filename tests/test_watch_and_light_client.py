@@ -1,0 +1,130 @@
+"""Watch analytics daemon + light-client bootstrap/update following
+(reference: watch/, light-client server paths, SURVEY.md §2.5)."""
+
+import pytest
+
+from lighthouse_tpu.http_api import BeaconApiServer
+from lighthouse_tpu.common.eth2_client import BeaconNodeHttpClient
+from lighthouse_tpu.light_client import (
+    LightClientError,
+    LightClientStore,
+    create_bootstrap,
+    create_optimistic_update,
+)
+from lighthouse_tpu.op_pool import OperationPool
+from lighthouse_tpu.testing.harness import BeaconChainHarness
+from lighthouse_tpu.types import ssz
+from lighthouse_tpu.validator_client import (
+    BeaconNodeFallback,
+    ValidatorClient,
+    ValidatorStore,
+)
+from lighthouse_tpu.watch import WatchDB, WatchUpdater
+
+N = 64
+
+
+@pytest.fixture(scope="module")
+def rig():
+    """A chain with real sync-aggregate participation (VC-driven)."""
+    h = BeaconChainHarness(n_validators=N)
+    h.chain.op_pool = OperationPool(h.types, h.spec)
+    server = BeaconApiServer(h.chain).start()
+    client = BeaconNodeHttpClient(server.url)
+    store = ValidatorStore(h.types, h.spec)
+    for i, sk in enumerate(h.keys):
+        store.add_validator(sk, index=i)
+    vc = ValidatorClient(store, BeaconNodeFallback([client]), h.types, h.spec)
+    for _ in range(4):
+        h.advance_slot()
+        vc.run_slot(h.current_slot)
+    yield {"h": h, "client": client}
+    server.stop()
+
+
+def test_ssz_field_proof_roundtrip(rig):
+    h = rig["h"]
+    state = h.chain.head.state
+    fork = h.chain.fork_at(state.slot)
+    cls = h.types.BeaconState[fork]
+    root = cls.hash_tree_root(state)
+    for field in ("slot", "current_sync_committee", "finalized_checkpoint"):
+        typ = dict(cls._ssz_fields)[field]
+        index, leaf, branch = ssz.container_field_proof(cls, state, field)
+        assert leaf == typ.hash_tree_root(getattr(state, field))
+        assert ssz.verify_field_proof(root, leaf, branch, index)
+        # corrupt one sibling: proof fails
+        bad = list(branch)
+        bad[0] = b"\xff" * 32
+        assert not ssz.verify_field_proof(root, leaf, bad, index)
+
+
+def test_light_client_bootstrap_and_follow(rig):
+    h = rig["h"]
+    chain = h.chain
+    # anchor two blocks back so an optimistic update can advance the head
+    anchor_root, anchor_slot = None, None
+    roots = list(chain.store.iter_block_roots_back(chain.head.block_root))
+    assert len(roots) >= 3
+    anchor_root = roots[2][0]
+
+    bootstrap = create_bootstrap(chain, anchor_root)
+    genesis_root = bytes(chain.head.state.genesis_validators_root)
+    store = LightClientStore(
+        h.types, h.spec,
+        trusted_block_root=anchor_root,
+        genesis_validators_root=genesis_root,
+        fork_version=h.spec.fork_version_for_name("capella"),
+    )
+    store.process_bootstrap(bootstrap)
+    assert store.optimistic_header.slot == roots[2][1]
+
+    # follow the child blocks via their sync aggregates
+    child_root = roots[1][0]
+    update = create_optimistic_update(chain, child_root)
+    store.process_optimistic_update(update)
+    assert store.optimistic_header.slot == roots[2][1] or \
+        store.optimistic_header.slot >= roots[2][1]
+
+    head_update = create_optimistic_update(chain, roots[0][0])
+    store.process_optimistic_update(head_update)
+    assert store.optimistic_header.slot == roots[1][1]
+
+    # tampered header is rejected
+    bad = create_optimistic_update(chain, roots[0][0])
+    bad.attested_header.proposer_index += 1
+    with pytest.raises(LightClientError):
+        store.process_optimistic_update(bad)
+
+
+def test_light_client_wrong_anchor_rejected(rig):
+    h = rig["h"]
+    chain = h.chain
+    bootstrap = create_bootstrap(chain, chain.head.block_root)
+    store = LightClientStore(
+        h.types, h.spec,
+        trusted_block_root=b"\x12" * 32,
+        genesis_validators_root=b"\x00" * 32,
+        fork_version=b"\x00" * 4,
+    )
+    with pytest.raises(LightClientError):
+        store.process_bootstrap(bootstrap)
+
+
+def test_watch_updater_ingests_chain(rig):
+    h, client = rig["h"], rig["client"]
+    db = WatchDB()
+    updater = WatchUpdater(db, client, types=h.types)
+    n = updater.update()
+    assert n >= 4
+    head_slot = h.chain.head.state.slot
+    blk = db.block_at_slot(head_slot)
+    assert blk is not None
+    assert blk["attestation_count"] >= 0
+    assert blk["sync_participation"] > 0  # VC drove sync committees
+    stats = db.packing_stats()
+    assert stats["blocks"] >= 4
+    counts = db.proposer_counts()
+    assert sum(counts.values()) == stats["blocks"]
+    # updater is incremental
+    assert updater.update() == 0
