@@ -1,0 +1,101 @@
+"""Structured logging: terminal/file/JSON sinks + an SSE tail.
+
+Mirror of common/logging (slog there): a configured stdlib logger with
+key=value structured records, optional JSON formatting, rotating file
+output, and `SSELoggingHandler` buffering recent records for dashboard
+tails (sse_logging_components.rs).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import logging.handlers
+import time
+from typing import Deque, List, Optional
+
+
+class KvFormatter(logging.Formatter):
+    """`Jan 01 00:00:00.000 INFO message, key: value, ...` (slog-term)."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        ts = time.strftime("%b %d %H:%M:%S", time.localtime(record.created))
+        ms = int(record.msecs)
+        kvs = getattr(record, "kv", {})
+        tail = "".join(f", {k}: {v}" for k, v in kvs.items())
+        return f"{ts}.{ms:03d} {record.levelname:5s} {record.getMessage()}{tail}"
+
+
+class JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": record.created,
+            "level": record.levelname,
+            "msg": record.getMessage(),
+            "module": record.name,
+        }
+        out.update(getattr(record, "kv", {}))
+        return json.dumps(out)
+
+
+class SSELoggingHandler(logging.Handler):
+    """Ring buffer of recent formatted records, drainable by the events API
+    (logging/src/sse_logging_components.rs)."""
+
+    def __init__(self, capacity: int = 512):
+        super().__init__()  # Handler provides self.lock; handle() serializes emit
+        self.buffer: Deque[str] = collections.deque(maxlen=capacity)
+
+    def emit(self, record: logging.LogRecord) -> None:
+        self.buffer.append(self.format(record))
+
+    def drain(self) -> List[str]:
+        self.acquire()
+        try:
+            out = list(self.buffer)
+            self.buffer.clear()
+        finally:
+            self.release()
+        return out
+
+
+def init_logging(
+    level: int = logging.INFO,
+    json_format: bool = False,
+    logfile: Optional[str] = None,
+    max_bytes: int = 16 * 1024 * 1024,
+    backup_count: int = 3,
+    sse: bool = False,
+):
+    """Configure the `lighthouse_tpu` logger tree; returns (logger,
+    sse_handler|None). File output rotates+keeps `backup_count` archives
+    (the reference's async rotating file flags)."""
+    logger = logging.getLogger("lighthouse_tpu")
+    logger.setLevel(level)
+    logger.handlers.clear()
+    logger.propagate = False  # no double-printing via the root logger
+    formatter = JsonFormatter() if json_format else KvFormatter()
+
+    term = logging.StreamHandler()
+    term.setFormatter(formatter)
+    logger.addHandler(term)
+
+    if logfile:
+        fh = logging.handlers.RotatingFileHandler(
+            logfile, maxBytes=max_bytes, backupCount=backup_count
+        )
+        fh.setFormatter(formatter)
+        logger.addHandler(fh)
+
+    sse_handler = None
+    if sse:
+        sse_handler = SSELoggingHandler()
+        sse_handler.setFormatter(formatter)
+        logger.addHandler(sse_handler)
+    return logger, sse_handler
+
+
+def log_kv(logger: logging.Logger, level: int, msg: str, **kv) -> None:
+    """slog-style structured record: log_kv(log, INFO, "synced", slot=5)."""
+    logger.log(level, msg, extra={"kv": kv})

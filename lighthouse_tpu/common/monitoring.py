@@ -1,0 +1,101 @@
+"""Remote monitoring push + host health.
+
+Mirror of common/monitoring_api (gather.rs: periodic JSON push of
+process/beacon metrics to a remote endpoint) and common/system_health
+(host stats). psutil-free: reads /proc directly on Linux, degrades to
+zeros elsewhere.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+from typing import Callable, Dict, Optional
+
+
+def system_health() -> Dict[str, float]:
+    """Host stats (system_health crate): load, memory, disk of cwd."""
+    out = {"cpu_cores": float(os.cpu_count() or 0)}
+    try:
+        with open("/proc/loadavg") as f:
+            out["load_1m"] = float(f.read().split()[0])
+    except OSError:
+        out["load_1m"] = 0.0
+    try:
+        meminfo = {}
+        with open("/proc/meminfo") as f:
+            for line in f:
+                k, v = line.split(":", 1)
+                meminfo[k] = int(v.strip().split()[0]) * 1024
+        out["mem_total_bytes"] = float(meminfo.get("MemTotal", 0))
+        out["mem_available_bytes"] = float(meminfo.get("MemAvailable", 0))
+    except OSError:
+        out["mem_total_bytes"] = out["mem_available_bytes"] = 0.0
+    try:
+        st = os.statvfs(".")
+        out["disk_free_bytes"] = float(st.f_bavail * st.f_frsize)
+    except OSError:
+        out["disk_free_bytes"] = 0.0
+    return out
+
+
+class MonitoringService:
+    """Pushes {beacon stats, system health} JSON to a remote endpoint on an
+    interval (monitoring_api/src/gather.rs)."""
+
+    def __init__(self, endpoint: str,
+                 gather_fn: Optional[Callable[[], Dict]] = None,
+                 interval: float = 60.0):
+        self.endpoint = endpoint
+        self.gather_fn = gather_fn or (lambda: {})
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.pushes = 0
+        self.last_error: Optional[str] = None
+
+    def gather(self) -> Dict:
+        return {
+            "version": 1,
+            "timestamp_ms": int(time.time() * 1000),
+            "system": system_health(),
+            "beacon": self.gather_fn(),
+        }
+
+    def push_once(self) -> bool:
+        body = json.dumps(self.gather()).encode()
+        req = urllib.request.Request(
+            self.endpoint, data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                resp.read()
+            self.pushes += 1
+            self.last_error = None
+            return True
+        except Exception as e:
+            self.last_error = str(e)
+            return False
+
+    def start(self) -> "MonitoringService":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.push_once()
+            self._stop.wait(self.interval)

@@ -1,0 +1,169 @@
+"""Peer discovery — ENR records + a Kademlia-style lookup over the
+transport fabric.
+
+Mirror of lighthouse_network/src/discovery (discv5 0.4.1 there): nodes
+carry signed-equivalent ENR records (sequence number, peer id, subnet
+bitfields — enr.rs ATTESTATION_BITFIELD_ENR_KEY), bootstrap from seed
+nodes (boot_node/), answer FINDNODE queries with their closest known
+records by XOR distance, and filter results through subnet predicates
+(discovery/subnet_predicate.rs). The same frames ride the SimTransport in
+tests and a UDP codec in deployment.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set
+
+
+@dataclass
+class Enr:
+    """Ethereum Node Record (reduced): identity + liveness + capabilities."""
+
+    peer_id: str
+    seq: int = 1
+    attnets: int = 0     # 64-bit attestation-subnet bitfield
+    syncnets: int = 0    # 4-bit sync-committee bitfield
+    fork_digest: bytes = b"\x00" * 4
+
+    @property
+    def node_id(self) -> bytes:
+        return hashlib.sha256(self.peer_id.encode()).digest()
+
+    def subscribed_to_attnet(self, subnet: int) -> bool:
+        return bool((self.attnets >> subnet) & 1)
+
+
+def subnet_predicate(subnets: List[int]) -> Callable[[Enr], bool]:
+    """discovery/subnet_predicate.rs: keep peers on ANY wanted subnet."""
+
+    def pred(enr: Enr) -> bool:
+        return any(enr.subscribed_to_attnet(s) for s in subnets)
+
+    return pred
+
+
+def _distance(a: bytes, b: bytes) -> int:
+    return int.from_bytes(a, "big") ^ int.from_bytes(b, "big")
+
+
+class Discovery:
+    """Per-node discovery service; `transport.send` carries
+    ("disc_findnode", ...) / ("disc_nodes", ...) frames."""
+
+    MAX_RESPONSE = 16
+
+    def __init__(self, local_enr: Enr, transport):
+        self.local_enr = local_enr
+        self.transport = transport
+        self.records: Dict[str, Enr] = {}
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    # ------------------------------------------------------------- registry
+
+    def add_enr(self, enr: Enr) -> None:
+        if enr.peer_id == self.local_enr.peer_id:
+            return  # never table ourselves
+        with self._lock:
+            existing = self.records.get(enr.peer_id)
+            if existing is None or enr.seq > existing.seq:
+                self.records[enr.peer_id] = enr
+
+    def update_local_enr(self, **changes) -> None:
+        """Bump seq on every mutation (ENR semantics)."""
+        for k, v in changes.items():
+            setattr(self.local_enr, k, v)
+        self.local_enr.seq += 1
+
+    def table_len(self) -> int:
+        with self._lock:
+            return len(self.records)
+
+    # --------------------------------------------------------------- lookup
+
+    def find_peers(self, bootstrap: List[str],
+                   predicate: Optional[Callable[[Enr], bool]] = None,
+                   want: int = 16) -> List[Enr]:
+        """Iterative FINDNODE toward our own id (discv5's self-lookup):
+        query bootstrap + closest known until no closer records arrive."""
+        for peer in bootstrap:
+            self._query(peer)
+        # Iterate: query the closest unqueried records a few rounds.
+        queried: Set[str] = set(bootstrap)
+        for _ in range(3):
+            with self._lock:
+                candidates = sorted(
+                    self.records.values(),
+                    key=lambda e: _distance(e.node_id, self.local_enr.node_id),
+                )
+            next_up = [e.peer_id for e in candidates
+                       if e.peer_id not in queried][:3]
+            if not next_up:
+                break
+            for peer in next_up:
+                queried.add(peer)
+                self._query(peer)
+        with self._lock:
+            out = [e for e in self.records.values()
+                   if e.peer_id != self.local_enr.peer_id]
+        if predicate is not None:
+            out = [e for e in out if predicate(e)]
+        out.sort(key=lambda e: _distance(e.node_id, self.local_enr.node_id))
+        return out[:want]
+
+    def _query(self, peer_id: str) -> None:
+        import dataclasses
+
+        self._seq += 1
+        # Copy the ENR: frames model serialization, so a later local mutation
+        # must not reach into remote tables by reference.
+        self.transport.send(
+            self.local_enr.peer_id, peer_id,
+            ("disc_findnode", self._seq, dataclasses.replace(self.local_enr)),
+        )
+
+    # --------------------------------------------------------------- frames
+
+    def handle_frame(self, src: str, frame: tuple) -> None:
+        import dataclasses
+
+        kind = frame[0]
+        if kind == "disc_findnode":
+            _, seq, requester_enr = frame
+            self.add_enr(requester_enr)
+            with self._lock:
+                closest = sorted(
+                    (e for e in self.records.values()
+                     if e.peer_id != requester_enr.peer_id),
+                    key=lambda e: _distance(
+                        e.node_id, requester_enr.node_id
+                    ),
+                )[: self.MAX_RESPONSE]
+            self.transport.send(
+                self.local_enr.peer_id, src,
+                ("disc_nodes", seq,
+                 [dataclasses.replace(e)
+                  for e in [self.local_enr] + closest]),
+            )
+        elif kind == "disc_nodes":
+            _, seq, enrs = frame
+            for enr in enrs:
+                self.add_enr(enr)
+
+
+class BootNode:
+    """Standalone record-server (boot_node/): discovery with no chain."""
+
+    def __init__(self, peer_id: str, transport):
+        self.peer_id = peer_id
+        self.discovery = Discovery(Enr(peer_id=peer_id), transport)
+        if hasattr(transport, "register"):
+            transport.register(self)
+
+    def handle_frame(self, src: str, frame: tuple) -> None:
+        if frame[0].startswith("disc_"):
+            self.discovery.handle_frame(src, frame)

@@ -1,0 +1,144 @@
+"""Discovery (ENR + findnode + subnet predicates + boot node), structured
+logging sinks, monitoring push + system health (reference:
+lighthouse_network/src/discovery, common/logging, common/monitoring_api,
+common/system_health)."""
+
+import json
+import logging
+
+from lighthouse_tpu.common.logging import (
+    JsonFormatter,
+    SSELoggingHandler,
+    init_logging,
+    log_kv,
+)
+from lighthouse_tpu.common.monitoring import MonitoringService, system_health
+from lighthouse_tpu.network.discovery import (
+    BootNode,
+    Discovery,
+    Enr,
+    subnet_predicate,
+)
+from lighthouse_tpu.network.gossip import SimTransport
+
+
+class _DiscNode:
+    def __init__(self, pid, transport, attnets=0):
+        self.peer_id = pid
+        self.discovery = Discovery(
+            Enr(peer_id=pid, attnets=attnets), transport
+        )
+        transport.register(self)
+
+    def handle_frame(self, src, frame):
+        self.discovery.handle_frame(src, frame)
+
+
+def test_discovery_via_bootnode():
+    t = SimTransport()
+    boot = BootNode("boot", t)
+    nodes = [_DiscNode(f"n{i}", t, attnets=1 << (i % 4)) for i in range(8)]
+    # everyone registers with the bootnode first
+    for n in nodes:
+        n.discovery.find_peers(["boot"])
+    # a newcomer discovers the others through the bootnode
+    new = _DiscNode("newcomer", t)
+    found = new.discovery.find_peers(["boot"])
+    assert len(found) >= 6
+    names = {e.peer_id for e in found}
+    assert "boot" in names or len(names & {n.peer_id for n in nodes}) >= 6
+
+
+def test_subnet_predicate_filters():
+    t = SimTransport()
+    boot = BootNode("boot", t)
+    a = _DiscNode("a", t, attnets=0b0001)
+    b = _DiscNode("b", t, attnets=0b0100)
+    a.discovery.find_peers(["boot"])
+    b.discovery.find_peers(["boot"])
+    seeker = _DiscNode("seeker", t)
+    found = seeker.discovery.find_peers(
+        ["boot"], predicate=subnet_predicate([2])
+    )
+    assert {e.peer_id for e in found} == {"b"}
+
+
+def test_enr_seq_updates():
+    t = SimTransport()
+    d = Discovery(Enr(peer_id="x"), t)
+    seq0 = d.local_enr.seq
+    d.update_local_enr(attnets=0b11)
+    assert d.local_enr.seq == seq0 + 1
+    # stale records don't overwrite newer ones
+    d.add_enr(Enr(peer_id="y", seq=5, attnets=1))
+    d.add_enr(Enr(peer_id="y", seq=3, attnets=0))
+    assert d.records["y"].seq == 5 and d.records["y"].attnets == 1
+
+
+def test_logging_sinks(tmp_path):
+    logfile = str(tmp_path / "node.log")
+    logger, sse = init_logging(
+        level=logging.INFO, logfile=logfile, sse=True
+    )
+    log_kv(logger, logging.INFO, "synced", slot=42, peers=7)
+    for h in logger.handlers:
+        h.flush()
+    content = open(logfile).read()
+    assert "synced" in content and "slot: 42" in content
+    lines = sse.drain()
+    assert len(lines) == 1 and "peers: 7" in lines[0]
+    assert sse.drain() == []
+
+    # JSON formatter round-trips the kv pairs
+    rec = logging.LogRecord("n", logging.INFO, "", 0, "msg", (), None)
+    rec.kv = {"slot": 1}
+    out = json.loads(JsonFormatter().format(rec))
+    assert out["msg"] == "msg" and out["slot"] == 1
+
+
+def test_system_health_shape():
+    import sys
+
+    h = system_health()
+    assert h["cpu_cores"] > 0
+    if sys.platform == "linux":
+        assert h["mem_total_bytes"] > 0
+    else:  # degrades to zeros off-linux by contract
+        assert h["mem_total_bytes"] >= 0
+
+
+def test_monitoring_push(tmp_path):
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    received = []
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers["Content-Length"])
+            received.append(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        svc = MonitoringService(
+            f"http://127.0.0.1:{srv.server_address[1]}/",
+            gather_fn=lambda: {"head_slot": 7},
+        )
+        assert svc.push_once()
+        assert received[0]["beacon"]["head_slot"] == 7
+        assert "system" in received[0]
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+    # unreachable endpoint: graceful failure
+    bad = MonitoringService("http://127.0.0.1:1/")
+    assert bad.push_once() is False
+    assert bad.last_error
