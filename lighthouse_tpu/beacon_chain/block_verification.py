@@ -112,7 +112,7 @@ def signature_verify_block(
         raise BlockError("ParentUnknown", parent_root.hex())
     fork = chain.fork_at(block.slot)
     if pre_state.slot < block.slot:
-        sp.process_slots(pre_state, chain.types, chain.spec, block.slot, fork=fork)
+        pre_state = sp.process_slots(pre_state, chain.types, chain.spec, block.slot)
 
     verifier = BlockSignatureVerifier(
         pre_state, chain.types, chain.spec, get_pubkey=chain.pubkey_getter
@@ -191,7 +191,7 @@ def verify_chain_segment(chain, blocks: List[object]) -> List[SignatureVerifiedB
         block = signed_block.message
         fork = chain.fork_at(block.slot)
         if scratch.slot < block.slot:
-            sp.process_slots(scratch, chain.types, chain.spec, block.slot, fork=fork)
+            scratch = sp.process_slots(scratch, chain.types, chain.spec, block.slot)
         v = BlockSignatureVerifier(
             scratch, chain.types, chain.spec, get_pubkey=chain.pubkey_getter
         )

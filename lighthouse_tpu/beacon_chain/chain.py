@@ -59,6 +59,7 @@ class BeaconChain:
         slot_clock: Optional[SlotClock] = None,
         execution_layer=None,
         op_pool=None,
+        deposit_cache=None,
     ):
         self.types = types
         self.spec = spec
@@ -66,6 +67,7 @@ class BeaconChain:
         self.bls_backend = bls_backend
         self.execution_layer = execution_layer
         self.op_pool = op_pool
+        self.deposit_cache = deposit_cache  # eth1 follower (deposits)
         self._lock = threading.RLock()
 
         fork = spec.fork_name_at_epoch(spec.epoch_at_slot(genesis_state.slot))
@@ -153,10 +155,9 @@ class BeaconChain:
         if h.get_current_epoch(state, self.spec) >= target_epoch:
             return state
         clone = state.copy()
-        sp.process_slots(
+        clone = sp.process_slots(
             clone, self.types, self.spec,
             self.spec.start_slot_of_epoch(target_epoch),
-            fork=self.fork_at(slot),
         )
         return clone
 
@@ -391,7 +392,7 @@ class BeaconChain:
             fork = self.fork_at(slot)
             parent_root = self.head.block_root
             state = self.state_for_block_import(parent_root)
-            sp.process_slots(state, t, spec, slot, fork=fork)
+            state = sp.process_slots(state, t, spec, slot)
             epoch = spec.epoch_at_slot(slot)
 
             attestations = []
@@ -399,6 +400,26 @@ class BeaconChain:
             attester_slashings: list = []
             exits: list = []
             bls_changes: list = []
+            deposits: list = []
+            # The spec REQUIRES min(MAX_DEPOSITS, pending) deposits when the
+            # state's eth1_data is ahead of its deposit index.
+            pending = state.eth1_data.deposit_count - state.eth1_deposit_index
+            if pending > 0 and self.deposit_cache is not None:
+                start = state.eth1_deposit_index
+                end = start + min(pending, spec.preset.MAX_DEPOSITS)
+                if self.deposit_cache.deposit_count() < end:
+                    raise RuntimeError(
+                        f"eth1 deposit cache not synced: have "
+                        f"{self.deposit_cache.deposit_count()}, block "
+                        f"requires deposits up to {end}"
+                    )
+                deposits = [
+                    t.Deposit(proof=proof, data=data)
+                    for data, proof in self.deposit_cache.get_deposits(
+                        start, end,
+                        deposit_count=state.eth1_data.deposit_count,
+                    )
+                ]
             if self.op_pool is not None:
                 committees_fn = lambda s, i: self.committees_at(s).committee(s, i)
                 attestations = self.op_pool.get_attestations(state, committees_fn)
@@ -445,6 +466,7 @@ class BeaconChain:
                 proposer_slashings=proposer_slashings,
                 attester_slashings=attester_slashings,
                 attestations=attestations,
+                deposits=deposits,
                 voluntary_exits=exits,
                 sync_aggregate=sync_aggregate,
                 execution_payload=payload,

@@ -122,8 +122,7 @@ def cmd_skip_slots(args) -> int:
     types, spec = _types_spec(args.preset)
     cls = types.BeaconState[ForkName.CAPELLA]
     state = cls.deserialize(open(args.pre, "rb").read())
-    sp.process_slots(state, types, spec, state.slot + args.slots,
-                     fork=ForkName.CAPELLA)
+    state = sp.process_slots(state, types, spec, state.slot + args.slots)
     open(args.output, "wb").write(cls.serialize(state))
     print(f"advanced to slot {state.slot}")
     return 0

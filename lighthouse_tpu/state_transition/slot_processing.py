@@ -27,20 +27,32 @@ def process_slot(state, types, spec, state_cls) -> None:
     state.block_roots[state.slot % P.SLOTS_PER_HISTORICAL_ROOT] = block_root
 
 
-def process_slots(state, types, spec, target_slot: int, fork: str = None) -> None:
+def process_slots(state, types, spec, target_slot: int, fork: str = None):
+    """Advance to target_slot, applying fork upgrades at activation epochs
+    (upgrade/*.rs via upgrades.maybe_upgrade). Mutates in place for in-fork
+    advancement; RETURNS the state (a new object across an upgrade — callers
+    that advance across fork boundaries must use the return value).
+
+    The per-slot fork is ALWAYS resolved from the spec so upgrades run on
+    every path (chain import, replay, production); `fork` is accepted for
+    API compatibility but no longer changes resolution — on canonical specs
+    a pinned caller and spec resolution agree within a fork."""
+    from . import upgrades
+
+    del fork
     if target_slot <= state.slot and target_slot != state.slot:
         raise SlotProcessingError(
             f"cannot rewind state from slot {state.slot} to {target_slot}"
         )
     while state.slot < target_slot:
-        cur_fork = fork or spec.fork_name_at_epoch(spec.epoch_at_slot(state.slot))
+        cur_fork = spec.fork_name_at_epoch(spec.epoch_at_slot(state.slot))
         state_cls = types.BeaconState[cur_fork]
         process_slot(state, types, spec, state_cls)
         if (state.slot + 1) % spec.preset.SLOTS_PER_EPOCH == 0:
             epoch_processing.process_epoch(state, types, spec, cur_fork)
         state.slot += 1
-        # Fork upgrade boundaries (upgrade/*.rs) are applied by the caller;
-        # in-fork transitions only here.
+        state = upgrades.maybe_upgrade(state, types, spec)
+    return state
 
 
 def state_transition(
@@ -54,7 +66,7 @@ def state_transition(
     if verify_signatures is None:
         verify_signatures = bp.VerifySignatures.TRUE
     block = signed_block.message
-    process_slots(state, types, spec, block.slot, fork=fork)
+    state = process_slots(state, types, spec, block.slot, fork=fork)
     bp.per_block_processing(
         state, types, spec, signed_block, fork,
         verify_signatures=verify_signatures, get_pubkey=get_pubkey,

@@ -1,0 +1,140 @@
+"""Fork-boundary state upgrades (reference: state_processing/src/upgrade/
+{altair,merge,capella,deneb}.rs).
+
+Each `upgrade_to_X(state, types, spec)` rebuilds the state in the next
+fork's container shape at the epoch boundary where the fork activates;
+`maybe_upgrade(state, types, spec)` applies whichever upgrade the state's
+slot has just crossed into. process_slots calls this at each epoch start.
+"""
+
+from __future__ import annotations
+
+from lighthouse_tpu.types.spec import ForkName
+
+
+def _copy_common(state, new_state, fields) -> None:
+    for f in fields:
+        setattr(new_state, f, getattr(state, f))
+
+
+_BASE_FIELDS = [
+    "genesis_time", "genesis_validators_root", "slot",
+    "latest_block_header", "block_roots", "state_roots", "historical_roots",
+    "eth1_data", "eth1_data_votes", "eth1_deposit_index",
+    "validators", "balances", "randao_mixes", "slashings",
+]
+
+_JUSTIFICATION_FIELDS = [
+    "justification_bits", "previous_justified_checkpoint",
+    "current_justified_checkpoint", "finalized_checkpoint",
+]
+
+_ALTAIR_FIELDS = [
+    "previous_epoch_participation", "current_epoch_participation",
+    "inactivity_scores", "current_sync_committee", "next_sync_committee",
+]
+
+
+def _bump_fork(state, new_state, spec, fork: str, epoch: int) -> None:
+    t_fork = type(state.fork)
+    new_state.fork = t_fork(
+        previous_version=state.fork.current_version,
+        current_version=spec.fork_version_for_name(fork),
+        epoch=epoch,
+    )
+
+
+def upgrade_to_capella(state, types, spec):
+    """Bellatrix -> Capella (upgrade/capella.rs): withdrawal bookkeeping +
+    historical summaries; the payload header gains withdrawals_root."""
+    epoch = spec.epoch_at_slot(state.slot)
+    new_state = types.BeaconStateCapella()
+    _copy_common(state, new_state,
+                 _BASE_FIELDS + _JUSTIFICATION_FIELDS + _ALTAIR_FIELDS)
+    _bump_fork(state, new_state, spec, ForkName.CAPELLA, epoch)
+    old = state.latest_execution_payload_header
+    new_state.latest_execution_payload_header = \
+        types.ExecutionPayloadHeaderCapella(
+            parent_hash=old.parent_hash, fee_recipient=old.fee_recipient,
+            state_root=old.state_root, receipts_root=old.receipts_root,
+            logs_bloom=old.logs_bloom, prev_randao=old.prev_randao,
+            block_number=old.block_number, gas_limit=old.gas_limit,
+            gas_used=old.gas_used, timestamp=old.timestamp,
+            extra_data=old.extra_data,
+            base_fee_per_gas=old.base_fee_per_gas,
+            block_hash=old.block_hash,
+            transactions_root=old.transactions_root,
+            withdrawals_root=b"\x00" * 32,
+        )
+    new_state.next_withdrawal_index = 0
+    new_state.next_withdrawal_validator_index = 0
+    new_state.historical_summaries = []
+    return new_state
+
+
+def upgrade_to_deneb(state, types, spec):
+    """Capella -> Deneb (upgrade/deneb.rs): payload header gains blob gas
+    fields; everything else carries over."""
+    epoch = spec.epoch_at_slot(state.slot)
+    new_state = types.BeaconStateDeneb()
+    _copy_common(state, new_state,
+                 _BASE_FIELDS + _JUSTIFICATION_FIELDS + _ALTAIR_FIELDS)
+    _bump_fork(state, new_state, spec, ForkName.DENEB, epoch)
+    old = state.latest_execution_payload_header
+    new_state.latest_execution_payload_header = \
+        types.ExecutionPayloadHeaderDeneb(
+            parent_hash=old.parent_hash, fee_recipient=old.fee_recipient,
+            state_root=old.state_root, receipts_root=old.receipts_root,
+            logs_bloom=old.logs_bloom, prev_randao=old.prev_randao,
+            block_number=old.block_number, gas_limit=old.gas_limit,
+            gas_used=old.gas_used, timestamp=old.timestamp,
+            extra_data=old.extra_data,
+            base_fee_per_gas=old.base_fee_per_gas,
+            block_hash=old.block_hash,
+            transactions_root=old.transactions_root,
+            withdrawals_root=old.withdrawals_root,
+            blob_gas_used=0,
+            excess_blob_gas=0,
+        )
+    new_state.next_withdrawal_index = state.next_withdrawal_index
+    new_state.next_withdrawal_validator_index = \
+        state.next_withdrawal_validator_index
+    new_state.historical_summaries = list(state.historical_summaries)
+    return new_state
+
+
+def maybe_upgrade(state, types, spec):
+    """Apply the upgrade whose activation epoch starts at state.slot
+    (process_slots hook); returns the (possibly new) state.
+
+    Coverage: bellatrix->capella and capella->deneb (the forks the block
+    pipeline supports). Crossing the altair or bellatrix activation from an
+    older state raises — phase0/altair pending-attestation translation is
+    out of scope (block_processing supports altair+ accounting only)."""
+    P = spec.preset
+    if state.slot % P.SLOTS_PER_EPOCH != 0:
+        return state
+    epoch = spec.epoch_at_slot(state.slot)
+    if spec.altair_fork_epoch is not None and \
+            epoch == spec.altair_fork_epoch and \
+            isinstance(state, types.BeaconStateBase):
+        raise NotImplementedError(
+            "phase0 -> altair upgrade (pending-attestation translation) is "
+            "unsupported; start chains at altair or later"
+        )
+    if spec.bellatrix_fork_epoch is not None and \
+            epoch == spec.bellatrix_fork_epoch and \
+            isinstance(state, types.BeaconStateAltair):
+        raise NotImplementedError(
+            "altair -> bellatrix upgrade is unsupported; start chains at "
+            "bellatrix or later"
+        )
+    if spec.capella_fork_epoch is not None and \
+            epoch == spec.capella_fork_epoch and \
+            isinstance(state, types.BeaconStateBellatrix):
+        return upgrade_to_capella(state, types, spec)
+    if spec.deneb_fork_epoch is not None and \
+            epoch == spec.deneb_fork_epoch and \
+            isinstance(state, types.BeaconStateCapella):
+        return upgrade_to_deneb(state, types, spec)
+    return state

@@ -289,16 +289,13 @@ class HotColdDB:
         for signed_block in blocks:
             block = signed_block.message
             fork = self._fork_at_slot(block.slot)
-            sp.process_slots(state, types, spec, block.slot, fork=fork)
+            state = sp.process_slots(state, types, spec, block.slot)
             bp.per_block_processing(
                 state, types, spec, signed_block, fork,
                 verify_signatures=bp.VerifySignatures.FALSE,
             )
         if state.slot < target_slot:
-            sp.process_slots(
-                state, types, spec, target_slot,
-                fork=self._fork_at_slot(target_slot),
-            )
+            state = sp.process_slots(state, types, spec, target_slot)
         return state
 
     # -- metadata -----------------------------------------------------------

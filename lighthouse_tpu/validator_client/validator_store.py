@@ -69,6 +69,15 @@ class ValidatorStore:
     def voting_pubkeys(self) -> List[bytes]:
         return list(self._signers)
 
+    def remove_validator(self, pubkey: bytes) -> bool:
+        """Drop a key from signing duty (slashing history is retained — the
+        DB must survive key removal per EIP-3076)."""
+        if pubkey not in self._signers:
+            return False
+        del self._signers[pubkey]
+        self._indices.pop(pubkey, None)
+        return True
+
     def set_index(self, pubkey: bytes, index: int) -> None:
         self._indices[pubkey] = index
 

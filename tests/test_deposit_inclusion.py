@@ -1,0 +1,90 @@
+"""Deposit inclusion in block production: eth1 cache -> produce_block ->
+spec-valid proofs + onboarding (reference: the deposit flow across
+eth1/ + op inclusion + process_deposit)."""
+
+from lighthouse_tpu.crypto.bls.api import SecretKey
+from lighthouse_tpu.eth1 import DepositCache
+from lighthouse_tpu.state_transition import helpers as h
+from lighthouse_tpu.testing.harness import BeaconChainHarness
+from lighthouse_tpu.types.spec import (
+    DOMAIN_DEPOSIT,
+    compute_domain,
+    compute_signing_root,
+)
+
+
+from lighthouse_tpu.state_transition.genesis import bls_withdrawal_credentials
+
+
+def _signed_deposit_data(types, spec, sk, amount=32 * 10**9):
+    pubkey = sk.public_key().to_bytes()
+    wc = bls_withdrawal_credentials(pubkey)
+    data = types.DepositData(
+        pubkey=pubkey, withdrawal_credentials=wc, amount=amount,
+        signature=b"\x00" * 96,
+    )
+    msg = types.DepositMessage(
+        pubkey=pubkey, withdrawal_credentials=wc, amount=amount,
+    )
+    domain = compute_domain(DOMAIN_DEPOSIT, spec.genesis_fork_version,
+                            b"\x00" * 32)
+    root = compute_signing_root(msg, types.DepositMessage, domain)
+    data.signature = sk.sign(root).to_bytes()
+    return data
+
+
+def test_produced_block_carries_and_onboards_deposit():
+    harness = BeaconChainHarness(n_validators=16)
+    types, spec = harness.types, harness.spec
+
+    cache = DepositCache(types=types)
+    # The 16 interop-genesis deposits occupy leaves 0..15 (the state's
+    # eth1_deposit_index starts at 16); the new deposit is leaf 16.
+    for sk in harness.keys:
+        cache.insert_deposit(_signed_deposit_data(types, spec, sk))
+    new_sk = SecretKey(987654321)
+    data = _signed_deposit_data(types, spec, new_sk)
+    cache.insert_deposit(data)
+
+    # Bake the eth1-voting outcome into GENESIS (mutating a live state
+    # would break the header/root chain): eth1_data commits to the
+    # 1-deposit tree before the chain derives any roots from the state.
+    from lighthouse_tpu.beacon_chain import BeaconChain
+    from lighthouse_tpu.state_transition import genesis as gen
+
+    genesis_state = gen.interop_genesis_state(
+        types, spec, harness.keys, genesis_time=1_600_000_000
+    )
+    genesis_state.eth1_data = types.Eth1Data(
+        deposit_root=cache.tree.root_at_count(17),
+        deposit_count=17,
+        block_hash=b"\x11" * 32,
+    )
+    harness.chain = BeaconChain(
+        types, spec, genesis_state, deposit_cache=cache
+    )
+    chain = harness.chain
+
+    harness.advance_slot()
+    slot = harness.current_slot
+    proposer_state = chain.head_state_clone_at(slot)
+    from lighthouse_tpu.state_transition import slot_processing as sp
+
+    work = chain.state_for_block_import(chain.head.block_root)
+    sp.process_slots(work, types, spec, slot, fork=chain.fork_at(slot))
+    proposer = h.get_beacon_proposer_index(work, spec)
+    reveal = harness.randao_reveal(work, spec.epoch_at_slot(slot), proposer)
+
+    block, post = chain.produce_block(slot, reveal)
+    assert len(block.body.deposits) == 1
+    # the new validator onboarded in the post state
+    assert len(post.validators) == 17
+    assert bytes(post.validators[16].pubkey) == new_sk.public_key().to_bytes()
+    assert post.eth1_deposit_index == 17
+
+    # the signed block imports through the full pipeline
+    signed = harness.sign_block(
+        chain.head_state_for_signatures(), block, chain.fork_at(slot)
+    )
+    chain.process_block(signed)
+    assert len(chain.head.state.validators) == 17

@@ -1,0 +1,82 @@
+"""Keymanager API: auth, list/import/delete keystores with slashing
+interchange, fee recipient + graffiti overrides (reference:
+validator_client/src/http_api keymanager surface)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu.crypto import keystore as ks
+from lighthouse_tpu.crypto.bls.api import SecretKey
+from lighthouse_tpu.types.containers import make_types
+from lighthouse_tpu.types.spec import minimal_spec
+from lighthouse_tpu.validator_client import ValidatorStore
+from lighthouse_tpu.validator_client.http_api import KeymanagerApi
+
+
+@pytest.fixture()
+def api():
+    spec = minimal_spec()
+    store = ValidatorStore(make_types(spec.preset), spec)
+    store.add_validator(SecretKey(111), index=0)
+    server = KeymanagerApi(store, token="testtoken").start()
+    yield server
+    server.stop()
+
+
+def _call(api, method, path, body=None, token="testtoken"):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(api.url + path, data=data, method=method)
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    if data:
+        req.add_header("Content-Type", "application/json")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def test_auth_required(api):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _call(api, "GET", "/eth/v1/keystores", token=None)
+    assert ei.value.code == 401
+
+
+def test_list_import_delete_roundtrip(api):
+    out = _call(api, "GET", "/eth/v1/keystores")
+    assert len(out["data"]) == 1
+
+    sk = SecretKey(222)
+    keystore = ks.encrypt_keystore(
+        sk.to_bytes(), "pw", sk.public_key().to_bytes(), iterations=1024
+    )
+    out = _call(api, "POST", "/eth/v1/keystores", {
+        "keystores": [keystore], "passwords": ["pw"],
+    })
+    assert out["data"][0]["status"] == "imported"
+    listed = _call(api, "GET", "/eth/v1/keystores")["data"]
+    assert len(listed) == 2
+
+    pk_hex = "0x" + sk.public_key().to_bytes().hex()
+    out = _call(api, "DELETE", "/eth/v1/keystores", {"pubkeys": [pk_hex]})
+    assert out["data"][0]["status"] == "deleted"
+    # the delete response carries the EIP-3076 interchange
+    interchange = json.loads(out["slashing_protection"])
+    assert interchange["metadata"]["interchange_format_version"] == "5"
+    assert len(_call(api, "GET", "/eth/v1/keystores")["data"]) == 1
+    # deleting again: not_found
+    out = _call(api, "DELETE", "/eth/v1/keystores", {"pubkeys": [pk_hex]})
+    assert out["data"][0]["status"] == "not_found"
+
+
+def test_fee_recipient_and_graffiti(api):
+    pk = _call(api, "GET", "/eth/v1/keystores")["data"][0]["validating_pubkey"]
+    _call(api, "POST", f"/eth/v1/validator/{pk}/feerecipient",
+          {"ethaddress": "0x" + "ab" * 20})
+    out = _call(api, "GET", f"/eth/v1/validator/{pk}/feerecipient")
+    assert out["data"]["ethaddress"] == "0x" + "ab" * 20
+    _call(api, "POST", f"/eth/v1/validator/{pk}/graffiti",
+          {"graffiti": "hello"})
+    assert _call(api, "GET", f"/eth/v1/validator/{pk}/graffiti")[
+        "data"]["graffiti"] == "hello"

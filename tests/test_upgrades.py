@@ -1,0 +1,63 @@
+"""Fork-boundary upgrades (reference: state_processing/src/upgrade/*.rs +
+the transition ef-test tier shape)."""
+
+from dataclasses import replace
+
+from lighthouse_tpu.state_transition import genesis as gen
+from lighthouse_tpu.state_transition import slot_processing as sp
+from lighthouse_tpu.types.containers import make_types
+from lighthouse_tpu.types.spec import ForkName, minimal_spec
+
+
+def test_capella_to_deneb_upgrade_at_boundary():
+    spec = replace(minimal_spec(), deneb_fork_epoch=1)
+    types = make_types(spec.preset)
+    keys = gen.generate_deterministic_keypairs(16)
+    state = gen.interop_genesis_state(types, spec, keys,
+                                      genesis_time=1_600_000_000)
+    assert isinstance(state, types.BeaconStateCapella)
+
+    per_epoch = spec.preset.SLOTS_PER_EPOCH
+    # advance across the deneb activation epoch (fork resolved per slot)
+    state = sp.process_slots(state, types, spec, per_epoch + 1)
+    assert isinstance(state, types.BeaconStateDeneb)
+    assert state.slot == per_epoch + 1
+    assert bytes(state.fork.current_version) == spec.deneb_fork_version
+    assert bytes(state.fork.previous_version) == spec.capella_fork_version
+    assert state.fork.epoch == 1
+    # carried-over content
+    assert len(state.validators) == 16
+    hdr = state.latest_execution_payload_header
+    assert hdr.blob_gas_used == 0 and hdr.excess_blob_gas == 0
+    # the deneb state merkleizes + round-trips
+    cls = types.BeaconStateDeneb
+    data = cls.serialize(state)
+    assert cls.serialize(cls.deserialize(data)) == data
+
+
+def test_fork_arg_is_ignored_upgrades_always_apply():
+    """Upgrades run on EVERY path (the chain pins `fork` per target slot;
+    that must not suppress boundary upgrades)."""
+    spec = replace(minimal_spec(), deneb_fork_epoch=1)
+    types = make_types(spec.preset)
+    keys = gen.generate_deterministic_keypairs(16)
+    state = gen.interop_genesis_state(types, spec, keys,
+                                      genesis_time=1_600_000_000)
+    out = sp.process_slots(
+        state, types, spec, spec.preset.SLOTS_PER_EPOCH + 1,
+        fork=ForkName.CAPELLA,  # legacy arg: ignored
+    )
+    assert isinstance(out, types.BeaconStateDeneb)
+
+
+def test_unsupported_upgrade_raises():
+    import pytest as _pytest
+
+    from lighthouse_tpu.state_transition import upgrades
+
+    spec = replace(minimal_spec(), altair_fork_epoch=1, bellatrix_fork_epoch=1,
+                   capella_fork_epoch=1)
+    types = make_types(spec.preset)
+    base = types.BeaconStateBase(slot=spec.preset.SLOTS_PER_EPOCH)
+    with _pytest.raises(NotImplementedError):
+        upgrades.maybe_upgrade(base, types, spec)
