@@ -50,36 +50,51 @@ def _make_sets():
     return sets
 
 
+def _emit(sigs_per_sec: float, error: str = "") -> None:
+    out = {
+        "metric": "bls_batch_verify_throughput",
+        "value": round(sigs_per_sec, 2),
+        "unit": "sigs/sec",
+        "vs_baseline": round(sigs_per_sec / BLST_CPU_BASELINE_SIGS_PER_SEC, 4),
+    }
+    if error:
+        out["error"] = error
+    print(json.dumps(out))
+
+
 def main():
-    import jax
+    try:
+        import jax
 
-    from lighthouse_tpu.ops import backend as be
+        from lighthouse_tpu.ops import backend as be
 
-    sets = _make_sets()
-    n_dev = len(jax.devices())
-    sharded = n_dev > 1 and N_SETS % n_dev == 0
+        sets = _make_sets()
+        n_dev = len(jax.devices())
+        sharded = n_dev > 1 and N_SETS % n_dev == 0
 
-    # Warm-up: compile (persistent-cached) + one correctness check.
-    ok = be.verify_signature_sets_tpu(sets, sharded=sharded)
-    assert ok, "benchmark batch must verify"
+        # Warm-up: compile (persistent-cached) + one correctness check.
+        ok = be.verify_signature_sets_tpu(sets, sharded=sharded)
+        if not ok:
+            _emit(0.0, "benchmark batch failed verification")
+            return 1
 
-    t0 = time.perf_counter()
-    for _ in range(TIMED_ITERS):
-        assert be.verify_signature_sets_tpu(sets, sharded=sharded)
-    dt = time.perf_counter() - t0
-
-    sigs_per_sec = N_SETS * TIMED_ITERS / dt
-    print(
-        json.dumps(
-            {
-                "metric": "bls_batch_verify_throughput",
-                "value": round(sigs_per_sec, 2),
-                "unit": "sigs/sec",
-                "vs_baseline": round(sigs_per_sec / BLST_CPU_BASELINE_SIGS_PER_SEC, 4),
-            }
-        )
-    )
+        # Time at least TIMED_ITERS iterations and at least ~2 seconds.
+        iters = 0
+        t0 = time.perf_counter()
+        while iters < TIMED_ITERS or time.perf_counter() - t0 < 2.0:
+            if not be.verify_signature_sets_tpu(sets, sharded=sharded):
+                _emit(0.0, "verification flaked mid-benchmark")
+                return 1
+            iters += 1
+            if iters >= 50:
+                break
+        dt = time.perf_counter() - t0
+        _emit(N_SETS * iters / dt)
+        return 0
+    except Exception as e:  # the driver needs its JSON line no matter what
+        _emit(0.0, repr(e))
+        return 1
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

@@ -167,24 +167,18 @@ def batch_verify_unaggregated_attestations(
 
     if staged:
         sets = [s[3] for s in staged]
-        if bls.verify_signature_sets(sets, backend=chain.bls_backend):
-            for i, ind, iatt, _ in staged:
+        # Poisoned batches isolate culprits by bisection (log2 passes, not
+        # n per-item re-verifies — batch.rs:123-134 upgraded per SURVEY §7.3).
+        bad = set(bls.find_invalid_sets(sets, backend=chain.bls_backend))
+        for pos, (i, ind, iatt, _) in enumerate(staged):
+            if pos in bad:
+                results[i] = AttestationError("InvalidSignature")
+            else:
                 results[i] = VerifiedUnaggregatedAttestation(
                     attestation=attestations[i][0],
                     validator_index=ind.validator_index,
                     indexed_attestation=iatt,
                 )
-        else:
-            # Poisoned batch: find the culprit(s) one by one (batch.rs:123-134).
-            for i, ind, iatt, sset in staged:
-                if bls.verify_signature_sets([sset], backend=chain.bls_backend):
-                    results[i] = VerifiedUnaggregatedAttestation(
-                        attestation=attestations[i][0],
-                        validator_index=ind.validator_index,
-                        indexed_attestation=iatt,
-                    )
-                else:
-                    results[i] = AttestationError("InvalidSignature")
     return results
 
 
@@ -283,20 +277,21 @@ def batch_verify_aggregated_attestations(
             results[i] = e
 
     if staged:
-        all_sets = [s for _, _, sets in staged for s in sets]
-        if bls.verify_signature_sets(all_sets, backend=chain.bls_backend):
-            for i, ind, _ in staged:
+        # Flatten each aggregate's sets, keeping the flat-index -> item map
+        # explicit (no assumption about how many sets an item contributes).
+        all_sets = []
+        owner = []
+        for pos, (_, _, sets) in enumerate(staged):
+            all_sets.extend(sets)
+            owner.extend([pos] * len(sets))
+        bad_sets = bls.find_invalid_sets(all_sets, backend=chain.bls_backend)
+        bad_items = {owner[f] for f in bad_sets}
+        for pos, (i, ind, _) in enumerate(staged):
+            if pos in bad_items:
+                results[i] = AttestationError("InvalidSignature")
+            else:
                 results[i] = VerifiedAggregatedAttestation(
                     signed_aggregate=signed_aggregates[i],
                     indexed_attestation=ind.indexed_attestation,
                 )
-        else:
-            for i, ind, sets in staged:
-                if bls.verify_signature_sets(sets, backend=chain.bls_backend):
-                    results[i] = VerifiedAggregatedAttestation(
-                        signed_aggregate=signed_aggregates[i],
-                        indexed_attestation=ind.indexed_attestation,
-                    )
-                else:
-                    results[i] = AttestationError("InvalidSignature")
     return results

@@ -132,12 +132,11 @@ def batch_verify_sync_committee_messages(
 
     if staged:
         sets = [s for _, _, s in staged]
-        ok = bls.verify_signature_sets(sets, backend=chain.bls_backend)
-        for i, positions, sset in staged:
-            item_ok = ok or bls.verify_signature_sets(
-                [sset], backend=chain.bls_backend
-            )
-            if item_ok:
+        bad = set(bls.find_invalid_sets(sets, backend=chain.bls_backend))
+        for pos, (i, positions, _sset) in enumerate(staged):
+            if pos in bad:
+                results[i] = SyncCommitteeError("InvalidSignature")
+            else:
                 # Observe only what verified (see the single-item path).
                 chain.observed_sync_contributors.observe(
                     messages[i].slot, messages[i].validator_index
@@ -146,8 +145,6 @@ def batch_verify_sync_committee_messages(
                     message=messages[i],
                     subnet_id=positions[0] // sub_size,
                 )
-            else:
-                results[i] = SyncCommitteeError("InvalidSignature")
     return results
 
 

@@ -1,0 +1,104 @@
+"""TaskExecutor — supervised task spawning with shutdown + metrics.
+
+Mirror of common/task_executor (src/lib.rs:72,169,207): `spawn` for
+lightweight tasks, `spawn_blocking` for CPU-bound work routed to a pool,
+both wired to a shutdown signal and per-task-name metrics; dropping the
+executor (shutdown) stops accepting work and can signal the process to
+exit (the exit_on_panic analog: a task that raises trips the shutdown
+sender when critical=True).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Dict, Optional
+
+from .metrics import REGISTRY
+
+
+class ShutdownSignal:
+    """oneshot_broadcast analog: one trigger, many waiters."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self.reason: Optional[str] = None
+
+    def fire(self, reason: str = "shutdown") -> None:
+        if not self._event.is_set():
+            self.reason = reason
+            self._event.set()
+
+    def is_fired(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+
+class TaskExecutor:
+    def __init__(self, blocking_workers: int = 4,
+                 shutdown: Optional[ShutdownSignal] = None):
+        self.shutdown = shutdown or ShutdownSignal()
+        self._pool = ThreadPoolExecutor(max_workers=blocking_workers,
+                                        thread_name_prefix="blocking")
+        self._tasks_spawned = REGISTRY.counter(
+            "task_executor_tasks_total", "tasks spawned"
+        )
+        self._tasks_failed = REGISTRY.counter(
+            "task_executor_task_failures_total", "tasks that raised"
+        )
+        self._live = REGISTRY.gauge(
+            "task_executor_tasks_live", "currently running tasks"
+        )
+
+    # ------------------------------------------------------------- spawning
+
+    def spawn(self, fn: Callable, name: str = "task",
+              critical: bool = False) -> Optional[threading.Thread]:
+        """Fire-and-forget thread; returns None when shutting down."""
+        if self.shutdown.is_fired():
+            return None
+        self._tasks_spawned.inc()
+
+        def runner():
+            self._live.inc()
+            try:
+                fn()
+            except Exception:
+                self._tasks_failed.inc()
+                if critical:
+                    self.shutdown.fire(f"critical task {name!r} failed")
+            finally:
+                self._live.dec()
+
+        t = threading.Thread(target=runner, name=name, daemon=True)
+        t.start()
+        return t
+
+    def spawn_blocking(self, fn: Callable, name: str = "blocking",
+                       critical: bool = False) -> Optional[Future]:
+        """CPU-bound work on the bounded pool (spawn_blocking :207)."""
+        if self.shutdown.is_fired():
+            return None
+        self._tasks_spawned.inc()
+
+        def runner():
+            self._live.inc()
+            try:
+                return fn()
+            except Exception:
+                self._tasks_failed.inc()
+                if critical:
+                    self.shutdown.fire(f"critical task {name!r} failed")
+                raise
+            finally:
+                self._live.dec()
+
+        return self._pool.submit(runner)
+
+    # ------------------------------------------------------------- teardown
+
+    def stop(self, reason: str = "executor stopped") -> None:
+        self.shutdown.fire(reason)
+        self._pool.shutdown(wait=False, cancel_futures=True)

@@ -321,3 +321,33 @@ def verify_signature_sets(sets: Sequence[SignatureSet], backend: Optional[str] =
     if name == "tpu" and "tpu" not in _BACKENDS:
         from lighthouse_tpu.ops import backend as _tpu_backend  # noqa: F401
     return _BACKENDS[name](list(sets))
+
+
+def find_invalid_sets(
+    sets: Sequence[SignatureSet], backend: Optional[str] = None
+) -> list:
+    """Poisoned-batch isolation by BISECTION: a failing range splits in two
+    and each failing half recurses — ~2·log2(n)·k batch calls for k culprits
+    instead of the reference's n per-item re-verifications
+    (attestation_verification/batch.rs:123-134; SURVEY.md §7.3 item 4 says
+    do this on-device to avoid host round-trips — halving keeps every call
+    a power-of-two bucket the backend has already compiled).
+
+    Returns the indices of invalid sets (empty when the whole batch
+    verifies)."""
+    sets = list(sets)
+    out: list = []
+
+    def recurse(lo: int, hi: int) -> None:
+        if verify_signature_sets(sets[lo:hi], backend=backend):
+            return
+        if hi - lo == 1:
+            out.append(lo)
+            return
+        mid = (lo + hi) // 2
+        recurse(lo, mid)
+        recurse(mid, hi)
+
+    if sets:
+        recurse(0, len(sets))
+    return out

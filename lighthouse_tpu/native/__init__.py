@@ -37,11 +37,18 @@ def load(name: str) -> ctypes.CDLL:
         os.makedirs(_BUILD, exist_ok=True)
         if _needs_build(src, out):
             tmp = out + ".tmp"
-            subprocess.run(
-                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp, src],
-                check=True,
-                capture_output=True,
-            )
+            base = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC"]
+            try:
+                # -march=native unlocks SHA-NI/AVX paths where guarded by
+                # #ifdef in the sources; fall back to portable codegen.
+                subprocess.run(
+                    base + ["-march=native", "-o", tmp, src],
+                    check=True, capture_output=True,
+                )
+            except subprocess.CalledProcessError:
+                subprocess.run(
+                    base + ["-o", tmp, src], check=True, capture_output=True
+                )
             os.replace(tmp, out)
         lib = ctypes.CDLL(out)
         _cache[name] = lib

@@ -1,0 +1,378 @@
+// merkle — SHA-256 SSZ merkleization engine.
+//
+// Native equivalent of the reference's ethereum_hashing (SHA-NI assembly)
+// + tree_hash merkleization (SURVEY.md §2.7 item 5): the state-transition
+// hot loop is hashing (state roots recompute per slot). Exposes a C ABI:
+//
+//   merkleize(chunks, n, limit, out32)  — binary SSZ merkle root with
+//       virtual zero-padding to `limit` leaves (power of two);
+//   hash_pairs(data, n_pairs, out)      — one level of pairwise hashing
+//       (building block for incremental callers);
+//   sha256(data, len, out32).
+//
+// Straightforward portable SHA-256 (no intrinsics; the compiler vectorizes
+// the message schedule well at -O2 — replacing Python-loop merkleization is
+// where the 10-50x comes from, not sha extensions).
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+
+#if defined(__SHA__) && defined(__SSE4_1__)
+#define MERKLE_SHA_NI 1
+#include <immintrin.h>
+#endif
+
+namespace {
+
+constexpr uint32_t K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
+};
+
+inline uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+inline uint32_t rd32(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+inline void wr32(uint8_t* p, uint32_t v) {
+  p[0] = uint8_t(v >> 24);
+  p[1] = uint8_t(v >> 16);
+  p[2] = uint8_t(v >> 8);
+  p[3] = uint8_t(v);
+}
+
+struct Sha256 {
+  uint32_t h[8];
+  uint64_t total = 0;
+  uint8_t buf[64];
+  size_t fill = 0;
+
+  Sha256() { reset(); }
+
+  void reset() {
+    static const uint32_t init[8] = {
+        0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+        0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19,
+    };
+    memcpy(h, init, sizeof(h));
+    total = 0;
+    fill = 0;
+  }
+
+  void compress(const uint8_t* p) {
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++) w[i] = rd32(p + 4 * i);
+    for (int i = 16; i < 64; i++) {
+      uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+      uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3];
+    uint32_t e = h[4], f = h[5], g = h[6], hh = h[7];
+    for (int i = 0; i < 64; i++) {
+      uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + S1 + ch + K[i] + w[i];
+      uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = S0 + maj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+
+  void update(const uint8_t* data, size_t len) {
+    total += len;
+    if (fill) {
+      size_t take = 64 - fill < len ? 64 - fill : len;
+      memcpy(buf + fill, data, take);
+      fill += take;
+      data += take;
+      len -= take;
+      if (fill == 64) {
+        compress(buf);
+        fill = 0;
+      }
+    }
+    while (len >= 64) {
+      compress(data);
+      data += 64;
+      len -= 64;
+    }
+    if (len) {
+      memcpy(buf, data, len);
+      fill = len;
+    }
+  }
+
+  void final(uint8_t* out) {
+    uint64_t bits = total * 8;
+    uint8_t pad = 0x80;
+    update(&pad, 1);
+    uint8_t z = 0;
+    while (fill != 56) update(&z, 1);
+    uint8_t lenb[8];
+    for (int i = 0; i < 8; i++) lenb[i] = uint8_t(bits >> (56 - 8 * i));
+    update(lenb, 8);
+    for (int i = 0; i < 8; i++) wr32(out + 4 * i, h[i]);
+  }
+};
+
+#ifdef MERKLE_SHA_NI
+// SHA-NI one-block compression (standard Intel sequence). State in/out as
+// the usual 8x u32 words.
+void compress_ni(uint32_t state[8], const uint8_t* block) {
+  const __m128i MASK =
+      _mm_set_epi64x(0x0c0d0e0f08090a0bULL, 0x0405060700010203ULL);
+  __m128i tmp = _mm_loadu_si128((const __m128i*)&state[0]);
+  __m128i st1 = _mm_loadu_si128((const __m128i*)&state[4]);
+  tmp = _mm_shuffle_epi32(tmp, 0xB1);
+  st1 = _mm_shuffle_epi32(st1, 0x1B);
+  __m128i st0 = _mm_alignr_epi8(tmp, st1, 8);
+  st1 = _mm_blend_epi16(st1, tmp, 0xF0);
+  const __m128i abef_save = st0;
+  const __m128i cdgh_save = st1;
+
+  auto rounds4 = [&](__m128i msg, uint64_t k_hi, uint64_t k_lo) {
+    __m128i m = _mm_add_epi32(msg, _mm_set_epi64x(k_hi, k_lo));
+    st1 = _mm_sha256rnds2_epu32(st1, st0, m);
+    m = _mm_shuffle_epi32(m, 0x0E);
+    st0 = _mm_sha256rnds2_epu32(st0, st1, m);
+  };
+
+  __m128i msg0 = _mm_shuffle_epi8(
+      _mm_loadu_si128((const __m128i*)(block + 0)), MASK);
+  __m128i msg1 = _mm_shuffle_epi8(
+      _mm_loadu_si128((const __m128i*)(block + 16)), MASK);
+  __m128i msg2 = _mm_shuffle_epi8(
+      _mm_loadu_si128((const __m128i*)(block + 32)), MASK);
+  __m128i msg3 = _mm_shuffle_epi8(
+      _mm_loadu_si128((const __m128i*)(block + 48)), MASK);
+
+  rounds4(msg0, 0xE9B5DBA5B5C0FBCFULL, 0x71374491428A2F98ULL);
+  rounds4(msg1, 0xAB1C5ED5923F82A4ULL, 0x59F111F13956C25BULL);
+  msg0 = _mm_sha256msg1_epu32(msg0, msg1);
+  rounds4(msg2, 0x550C7DC3243185BEULL, 0x12835B01D807AA98ULL);
+  msg1 = _mm_sha256msg1_epu32(msg1, msg2);
+  rounds4(msg3, 0xC19BF1749BDC06A7ULL, 0x80DEB1FE72BE5D74ULL);
+  msg0 = _mm_add_epi32(msg0, _mm_alignr_epi8(msg3, msg2, 4));
+  msg0 = _mm_sha256msg2_epu32(msg0, msg3);
+  msg2 = _mm_sha256msg1_epu32(msg2, msg3);
+  rounds4(msg0, 0x240CA1CC0FC19DC6ULL, 0xEFBE4786E49B69C1ULL);
+  msg1 = _mm_add_epi32(msg1, _mm_alignr_epi8(msg0, msg3, 4));
+  msg1 = _mm_sha256msg2_epu32(msg1, msg0);
+  msg3 = _mm_sha256msg1_epu32(msg3, msg0);
+  rounds4(msg1, 0x76F988DA5CB0A9DCULL, 0x4A7484AA2DE92C6FULL);
+  msg2 = _mm_add_epi32(msg2, _mm_alignr_epi8(msg1, msg0, 4));
+  msg2 = _mm_sha256msg2_epu32(msg2, msg1);
+  msg0 = _mm_sha256msg1_epu32(msg0, msg1);
+  rounds4(msg2, 0xBF597FC7B00327C8ULL, 0xA831C66D983E5152ULL);
+  msg3 = _mm_add_epi32(msg3, _mm_alignr_epi8(msg2, msg1, 4));
+  msg3 = _mm_sha256msg2_epu32(msg3, msg2);
+  msg1 = _mm_sha256msg1_epu32(msg1, msg2);
+  rounds4(msg3, 0x1429296706CA6351ULL, 0xD5A79147C6E00BF3ULL);
+  msg0 = _mm_add_epi32(msg0, _mm_alignr_epi8(msg3, msg2, 4));
+  msg0 = _mm_sha256msg2_epu32(msg0, msg3);
+  msg2 = _mm_sha256msg1_epu32(msg2, msg3);
+  rounds4(msg0, 0x53380D134D2C6DFCULL, 0x2E1B213827B70A85ULL);
+  msg1 = _mm_add_epi32(msg1, _mm_alignr_epi8(msg0, msg3, 4));
+  msg1 = _mm_sha256msg2_epu32(msg1, msg0);
+  msg3 = _mm_sha256msg1_epu32(msg3, msg0);
+  rounds4(msg1, 0x92722C8581C2C92EULL, 0x766A0ABB650A7354ULL);
+  msg2 = _mm_add_epi32(msg2, _mm_alignr_epi8(msg1, msg0, 4));
+  msg2 = _mm_sha256msg2_epu32(msg2, msg1);
+  msg0 = _mm_sha256msg1_epu32(msg0, msg1);
+  rounds4(msg2, 0xC76C51A3C24B8B70ULL, 0xA81A664BA2BFE8A1ULL);
+  msg3 = _mm_add_epi32(msg3, _mm_alignr_epi8(msg2, msg1, 4));
+  msg3 = _mm_sha256msg2_epu32(msg3, msg2);
+  msg1 = _mm_sha256msg1_epu32(msg1, msg2);
+  rounds4(msg3, 0x106AA070F40E3585ULL, 0xD6990624D192E819ULL);
+  msg0 = _mm_add_epi32(msg0, _mm_alignr_epi8(msg3, msg2, 4));
+  msg0 = _mm_sha256msg2_epu32(msg0, msg3);
+  msg2 = _mm_sha256msg1_epu32(msg2, msg3);
+  rounds4(msg0, 0x34B0BCB52748774CULL, 0x1E376C0819A4C116ULL);
+  msg1 = _mm_add_epi32(msg1, _mm_alignr_epi8(msg0, msg3, 4));
+  msg1 = _mm_sha256msg2_epu32(msg1, msg0);
+  msg3 = _mm_sha256msg1_epu32(msg3, msg0);  // feeds W60-63
+  rounds4(msg1, 0x682E6FF35B9CCA4FULL, 0x4ED8AA4A391C0CB3ULL);
+  msg2 = _mm_add_epi32(msg2, _mm_alignr_epi8(msg1, msg0, 4));
+  msg2 = _mm_sha256msg2_epu32(msg2, msg1);
+  rounds4(msg2, 0x8CC7020884C87814ULL, 0x78A5636F748F82EEULL);
+  msg3 = _mm_add_epi32(msg3, _mm_alignr_epi8(msg2, msg1, 4));
+  msg3 = _mm_sha256msg2_epu32(msg3, msg2);
+  rounds4(msg3, 0xC67178F2BEF9A3F7ULL, 0xA4506CEB90BEFFFAULL);
+
+  st0 = _mm_add_epi32(st0, abef_save);
+  st1 = _mm_add_epi32(st1, cdgh_save);
+  tmp = _mm_shuffle_epi32(st0, 0x1B);
+  st1 = _mm_shuffle_epi32(st1, 0xB1);
+  st0 = _mm_blend_epi16(tmp, st1, 0xF0);
+  st1 = _mm_alignr_epi8(st1, tmp, 8);
+  _mm_storeu_si128((__m128i*)&state[0], st0);
+  _mm_storeu_si128((__m128i*)&state[4], st1);
+}
+#endif  // MERKLE_SHA_NI
+
+// Fixed-size two-chunk hash variants (the merkle inner loop): exactly two
+// compressions (64 bytes data + 1 constant padding block).
+
+void hash64_portable(const uint8_t* two_chunks, uint8_t* out) {
+  Sha256 s;
+  s.compress(two_chunks);
+  uint8_t pad[64] = {0};
+  pad[0] = 0x80;
+  pad[62] = 0x02;  // 512 bits big-endian = 0x0200
+  s.compress(pad);
+  for (int i = 0; i < 8; i++) wr32(out + 4 * i, s.h[i]);
+}
+
+#ifdef MERKLE_SHA_NI
+void hash64_ni(const uint8_t* two_chunks, uint8_t* out) {
+  static const uint8_t PAD[64] = {0x80, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+                                  0,    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+                                  0,    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+                                  0,    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+                                  0,    0, 0, 0, 0, 0, 0, 0, 0, 0, 0x02, 0};
+  uint32_t st[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                    0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+  compress_ni(st, two_chunks);
+  compress_ni(st, PAD);
+  for (int i = 0; i < 8; i++) wr32(out + 4 * i, st[i]);
+}
+#endif
+
+// Runtime dispatch: some virtualized hosts EMULATE sha256rnds2 orders of
+// magnitude slower than scalar code, so advertise-and-measure beats
+// advertise-and-trust. Calibrated once on first use.
+using Hash64Fn = void (*)(const uint8_t*, uint8_t*);
+std::atomic<Hash64Fn> g_hash64{nullptr};
+std::once_flag g_hash64_once;
+
+Hash64Fn pick_hash64() {
+#ifdef MERKLE_SHA_NI
+  // The binary may be cached/copied onto a host without SHA extensions:
+  // check support before even benchmarking the NI candidate (SIGILL
+  // otherwise).
+  if (!__builtin_cpu_supports("sha")) return hash64_portable;
+  uint8_t buf[64] = {1, 2, 3};
+  uint8_t out[32];
+  auto bench = [&](Hash64Fn fn) {
+    auto t0 = std::chrono::steady_clock::now();
+    for (int i = 0; i < 2000; i++) fn(buf, out);
+    return std::chrono::duration<double>(
+               std::chrono::steady_clock::now() - t0).count();
+  };
+  double t_ni = bench(hash64_ni);
+  double t_port = bench(hash64_portable);
+  return t_ni < t_port ? hash64_ni : hash64_portable;
+#else
+  return hash64_portable;
+#endif
+}
+
+inline void hash64(const uint8_t* two_chunks, uint8_t* out) {
+  Hash64Fn fn = g_hash64.load(std::memory_order_acquire);
+  if (!fn) {
+    std::call_once(g_hash64_once, [] {
+      g_hash64.store(pick_hash64(), std::memory_order_release);
+    });
+    fn = g_hash64.load(std::memory_order_acquire);
+  }
+  fn(two_chunks, out);
+}
+
+uint8_t ZERO_HASHES[65][32];
+std::once_flag g_zero_once;
+
+void init_zero_hashes() {
+  // ctypes drops the GIL, so concurrent first calls are real C++ threads:
+  // one-time init must be properly synchronized.
+  std::call_once(g_zero_once, [] {
+    memset(ZERO_HASHES[0], 0, 32);
+    uint8_t pair[64];
+    for (int d = 0; d < 64; d++) {
+      memcpy(pair, ZERO_HASHES[d], 32);
+      memcpy(pair + 32, ZERO_HASHES[d], 32);
+      hash64(pair, ZERO_HASHES[d + 1]);
+    }
+  });
+}
+
+}  // namespace
+
+extern "C" {
+
+void sha256(const uint8_t* data, uint64_t len, uint8_t* out) {
+  Sha256 s;
+  s.update(data, len);
+  s.final(out);
+}
+
+// One tree level: n_pairs x 64 bytes in -> n_pairs x 32 bytes out.
+// in/out may alias (out == in is safe: each output is written after its
+// input pair is consumed).
+void hash_pairs(const uint8_t* in, uint64_t n_pairs, uint8_t* out) {
+  for (uint64_t i = 0; i < n_pairs; i++) {
+    hash64(in + 64 * i, out + 32 * i);
+  }
+}
+
+// SSZ merkleize: root over `n` 32-byte chunks virtually padded with zero
+// chunks to `limit` leaves (limit = power of two >= n; limit 0/1 handled).
+// `scratch` must hold (n + 1) * 32 bytes (caller-provided, mutated): an
+// odd level writes one zero-hash chunk at scratch + 32*n.
+void merkleize(uint8_t* scratch, uint64_t n, uint64_t limit, uint8_t* out) {
+  init_zero_hashes();
+  if (limit == 0 || (limit == 1 && n <= 1)) {
+    if (n == 1) {
+      memcpy(out, scratch, 32);
+    } else {
+      memcpy(out, ZERO_HASHES[0], 32);
+    }
+    return;
+  }
+  int depth = 0;
+  while ((uint64_t(1) << depth) < limit) depth++;
+  uint64_t level_n = n;
+  for (int d = 0; d < depth; d++) {
+    if (level_n == 0) {
+      memcpy(out, ZERO_HASHES[depth], 32);
+      return;
+    }
+    if (level_n % 2) {
+      memcpy(scratch + 32 * level_n, ZERO_HASHES[d], 32);
+      level_n++;
+    }
+    hash_pairs(scratch, level_n / 2, scratch);
+    level_n /= 2;
+    // fold with zero subtrees once a level collapses to a single node but
+    // depth remains
+    if (level_n == 1 && d + 1 < depth) {
+      uint8_t pair[64];
+      for (int dd = d + 1; dd < depth; dd++) {
+        memcpy(pair, scratch, 32);
+        memcpy(pair + 32, ZERO_HASHES[dd], 32);
+        hash64(pair, scratch);
+      }
+      memcpy(out, scratch, 32);
+      return;
+    }
+  }
+  memcpy(out, scratch, 32);
+}
+
+}  // extern "C"

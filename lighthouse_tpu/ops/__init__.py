@@ -41,10 +41,24 @@ try:
 
     _orig_cache_write = _compiler._cache_write
 
+    # Read-only mode (LIGHTHOUSE_TPU_JAX_CACHE_READONLY=1): never serialize
+    # executables in this process. jaxlib's XLA:CPU executable serialization
+    # segfaults sporadically in long-running many-module processes (observed
+    # repeatedly under pytest); cache population is left to dedicated
+    # short-lived warmer runs, which have proven stable.
+    _CACHE_READONLY = os.environ.get(
+        "LIGHTHOUSE_TPU_JAX_CACHE_READONLY") == "1"
+    if _CACHE_READONLY:
+        # Public-API belt to the monkeypatch's suspenders: writes stay off
+        # even if the private _cache_write hook moves in a jax upgrade.
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 1e9
+        )
+
     def _bounded_cache_write(cache_key, compile_time_secs, module_name,
                              backend, executable, host_callbacks,
                              *args, **kwargs):
-        if compile_time_secs > _MAX_CACHE_COMPILE_SECS:
+        if _CACHE_READONLY or compile_time_secs > _MAX_CACHE_COMPILE_SECS:
             return
         return _orig_cache_write(cache_key, compile_time_secs, module_name,
                                  backend, executable, host_callbacks,

@@ -583,11 +583,52 @@ def _chunkify(data: bytes):
     return chunks
 
 
+# Native merkleizer (C++ SHA-256 tree engine — the reference links SHA-NI
+# assembly for exactly this loop). Loaded lazily; pure-Python fallback.
+_NATIVE_MERKLE = None
+_NATIVE_MERKLE_TRIED = False
+
+
+def _native_merkle():
+    global _NATIVE_MERKLE, _NATIVE_MERKLE_TRIED
+    if _NATIVE_MERKLE_TRIED:
+        return _NATIVE_MERKLE
+    _NATIVE_MERKLE_TRIED = True
+    try:
+        import ctypes
+
+        from lighthouse_tpu import native
+
+        lib = native.load("merkle")
+        lib.merkleize.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_char_p,
+        ]
+        _NATIVE_MERKLE = lib
+    except Exception:
+        _NATIVE_MERKLE = None
+    return _NATIVE_MERKLE
+
+
 def _merkleize_chunks(chunks, limit_chunks: int) -> bytes:
     """Merkle root over `chunks` padded (virtually) to next_pow2(limit)."""
-    depth = max(limit_chunks - 1, 0).bit_length()
     if len(chunks) > limit_chunks:
         raise SszError("chunk count exceeds limit")
+    lib = _native_merkle()
+    # Below ~256 chunks the ctypes marshal outweighs the C++ loop (hashlib
+    # is already native); above it the single native call wins.
+    if lib is not None and len(chunks) > 256:
+        import ctypes
+
+        n = len(chunks)
+        limit = 1
+        while limit < limit_chunks:
+            limit *= 2
+        scratch = ctypes.create_string_buffer(b"".join(chunks), (n + 1) * 32)
+        out = ctypes.create_string_buffer(32)
+        lib.merkleize(scratch, n, limit, out)
+        return out.raw[:32]
+    depth = max(limit_chunks - 1, 0).bit_length()
     layer = list(chunks)
     for d in range(depth):
         if len(layer) % 2:

@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Populate the persistent JAX compilation cache for the verification
+pipeline's production shapes.
+
+Run after kernel changes (each shape compiles once here, then every later
+process — pytest, the driver's dryrun, bench — loads it instantly):
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python scripts/warm_cache.py
+
+pytest itself runs with cache WRITES disabled (see tests/conftest.py):
+XLA:CPU executable serialization is flaky in long many-module processes,
+so only short dedicated runs like this one write entries.
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import __graft_entry__ as g
+
+    t0 = time.time()
+    fn, args = g.entry()
+    assert bool(fn(*args))
+    print(f"entry shapes warm ({time.time() - t0:.0f}s)")
+
+    t1 = time.time()
+    g.dryrun_multichip(8)
+    print(f"sharded dryrun shapes warm ({time.time() - t1:.0f}s)")
+
+    # bench shape (64 sets x 4 keys, single device)
+    from bench import _make_sets
+    from lighthouse_tpu.ops import backend as be
+
+    t2 = time.time()
+    assert be.verify_signature_sets_tpu(_make_sets(), sharded=False)
+    print(f"bench shapes warm ({time.time() - t2:.0f}s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -18,6 +18,10 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "1")
+# Pytest reads the persistent compile cache but never writes it: executable
+# serialization segfaults sporadically in long many-module processes; the
+# cache is populated by scripts/warm_cache.py instead.
+os.environ.setdefault("LIGHTHOUSE_TPU_JAX_CACHE_READONLY", "1")
 
 import jax  # noqa: E402
 

@@ -1,0 +1,56 @@
+"""TaskExecutor: spawn/spawn_blocking, shutdown gating, critical-failure
+escalation (reference: common/task_executor)."""
+
+import time
+
+from lighthouse_tpu.common.task_executor import ShutdownSignal, TaskExecutor
+
+
+def test_spawn_and_blocking_roundtrip():
+    ex = TaskExecutor()
+    out = []
+    t = ex.spawn(lambda: out.append(1), name="t1")
+    t.join(timeout=5)
+    fut = ex.spawn_blocking(lambda: 42)
+    assert fut.result(timeout=5) == 42
+    assert out == [1]
+    ex.stop()
+
+
+def test_critical_failure_fires_shutdown():
+    ex = TaskExecutor()
+
+    def boom():
+        raise RuntimeError("x")
+
+    t = ex.spawn(boom, name="c", critical=True)
+    t.join(timeout=5)
+    assert ex.shutdown.is_fired()
+    assert "critical task" in ex.shutdown.reason
+    # no new work accepted after shutdown
+    assert ex.spawn(lambda: None) is None
+    assert ex.spawn_blocking(lambda: None) is None
+
+
+def test_noncritical_failure_does_not_shutdown():
+    ex = TaskExecutor()
+
+    def boom():
+        raise RuntimeError("x")
+
+    fut = ex.spawn_blocking(boom)
+    try:
+        fut.result(timeout=5)
+    except RuntimeError:
+        pass
+    assert not ex.shutdown.is_fired()
+    ex.stop()
+
+
+def test_shutdown_signal_broadcast():
+    sig = ShutdownSignal()
+    assert not sig.wait(0.01)
+    sig.fire("test")
+    assert sig.wait(0.01)
+    sig.fire("second")  # first reason sticks
+    assert sig.reason == "test"
