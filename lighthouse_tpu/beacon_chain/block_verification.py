@@ -77,20 +77,36 @@ def gossip_verify_block(chain, signed_block) -> GossipVerifiedBlock:
     if not chain.block_is_known(parent_root):
         raise BlockError("ParentUnknown", parent_root.hex())
 
-    # Proposer-index + signature check against the head state's shuffling.
-    state = chain.head_state_for_signatures()
+    # Proposer-index + signature check against the PARENT lineage's
+    # shuffling (the head may be epochs behind during catch-up, and its
+    # empty-slot advance would miss the chain's randao contributions;
+    # the reference computes proposers from an ancestor of the block,
+    # beacon_proposer_cache keyed by shuffling decision root). Steady
+    # state (block builds on head, same epoch) touches no state clone.
     epoch = chain.spec.epoch_at_slot(block.slot)
-    proposers = chain.proposer_cache.get_or_compute(
-        chain.head_state_clone_at(block.slot), chain.spec, epoch
-    )
+    if parent_root == chain.head.block_root and \
+            chain.spec.epoch_at_slot(chain.head.state.slot) >= epoch:
+        sig_state = chain.head.state
+    else:
+        sig_state = chain.state_for_block_import(parent_root)
+        if sig_state is None:
+            raise BlockError("ParentUnknown", parent_root.hex())
+        target_start = chain.spec.start_slot_of_epoch(epoch)
+        if sig_state.slot < target_start:
+            sig_state = sp.process_slots(
+                sig_state, chain.types, chain.spec, target_start
+            )
+    proposers = chain.proposer_cache.get_or_compute(sig_state, chain.spec, epoch)
     expected = proposers[block.slot % chain.spec.preset.SLOTS_PER_EPOCH]
     if block.proposer_index != expected:
         raise BlockError(
             "IncorrectBlockProposer", f"{block.proposer_index} != {expected}"
         )
+    # sig_state is in the block's epoch, so its fork/domain are the block's
+    # (the head state could be a fork behind during catch-up).
     sset = sigsets.block_proposal_signature_set(
-        state, chain.types, chain.spec, signed_block, chain.fork_at(block.slot),
-        chain.pubkey_getter,
+        sig_state, chain.types, chain.spec, signed_block,
+        chain.fork_at(block.slot), chain.pubkey_getter,
     )
     if not bls.verify_signature_sets([sset], backend=chain.bls_backend):
         raise BlockError("ProposalSignatureInvalid")

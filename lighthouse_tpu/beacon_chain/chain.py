@@ -60,7 +60,13 @@ class BeaconChain:
         execution_layer=None,
         op_pool=None,
         deposit_cache=None,
+        anchor_block=None,
     ):
+        """`genesis_state` is the chain's *anchor* state — actual genesis for
+        a fresh chain, or a finalized checkpoint state for checkpoint sync
+        (client/src/builder.rs:157-330 anchoring). When `anchor_block` (the
+        signed block matching the anchor state) is supplied, it is stored and
+        an AnchorInfo backfill frontier is recorded (metadata.rs)."""
         self.types = types
         self.spec = spec
         self.store = store if store is not None else HotColdDB(types, spec)
@@ -82,8 +88,29 @@ class BeaconChain:
         genesis_block_root = types.BeaconBlockHeader.hash_tree_root(header)
 
         self.genesis_block_root = genesis_block_root
-        self.store.put_state(genesis_state_root, genesis_state)
+        self.store.put_state_full(genesis_state_root, genesis_state)
         self.store.put_genesis_block_root(genesis_block_root)
+
+        if anchor_block is not None:
+            blk_cls = types.BeaconBlock[self.spec.fork_name_at_epoch(
+                spec.epoch_at_slot(anchor_block.message.slot)
+            )]
+            if blk_cls.hash_tree_root(anchor_block.message) != genesis_block_root:
+                raise ValueError(
+                    "anchor block does not match anchor state's latest header"
+                )
+            self.store.put_block(genesis_block_root, anchor_block)
+            if self.store.get_anchor_info() is None and \
+                    anchor_block.message.slot > 0:
+                # Fresh checkpoint anchor: record the backfill frontier.
+                # (A resumed store keeps its existing frontier.)
+                from lighthouse_tpu.store.hot_cold import AnchorInfo
+
+                self.store.put_anchor_info(AnchorInfo(
+                    anchor_slot=genesis_state.slot,
+                    oldest_block_slot=anchor_block.message.slot,
+                    oldest_block_parent=bytes(anchor_block.message.parent_root),
+                ))
 
         cp = CheckpointSnapshot(
             epoch=spec.epoch_at_slot(genesis_state.slot), root=genesis_block_root
@@ -100,6 +127,10 @@ class BeaconChain:
         self.slot_clock = slot_clock or ManualSlotClock(
             genesis_state.genesis_time, spec.seconds_per_slot
         )
+        if slot_clock is None and genesis_state.slot > 0:
+            # Checkpoint anchor: the manual clock starts at the anchor slot
+            # (a wall clock positions itself from genesis_time instead).
+            self.slot_clock.set_slot(genesis_state.slot)
 
         # Cache fleet.
         self.pubkey_cache = ValidatorPubkeyCache(store=self.store)
@@ -119,10 +150,11 @@ class BeaconChain:
 
         self.head = CanonicalHead(
             block_root=genesis_block_root,
-            block=None,
+            block=anchor_block,
             state=genesis_state,
             state_root=genesis_state_root,
         )
+        self.store.put_head_info(genesis_block_root, genesis_state_root)
         self.snapshot_cache.insert(genesis_block_root, genesis_state)
         # Map block_root -> state_root for states we've imported (the hot
         # summaries carry this implicitly; this avoids a store read on the
@@ -238,6 +270,8 @@ class BeaconChain:
             self.pubkey_cache.import_new_pubkeys(state)
 
             self.recompute_head()
+            self.store.put_head_info(self.head.block_root,
+                                     self.head.state_root or state_root)
             if self.fork_choice.finalized.epoch > prev_finalized:
                 self._on_finalization()
             return root

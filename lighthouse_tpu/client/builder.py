@@ -24,16 +24,31 @@ from lighthouse_tpu.op_pool import OperationPool
 from lighthouse_tpu.state_transition import genesis as genesis_mod
 from lighthouse_tpu.store import HotColdDB, StoreConfig
 from lighthouse_tpu.types.containers import make_types
-from lighthouse_tpu.types.spec import ForkName, mainnet_spec, minimal_spec
+from lighthouse_tpu.types.spec import (
+    ForkName,
+    fork_for_block_ssz,
+    fork_for_state_ssz,
+    mainnet_spec,
+    minimal_spec,
+)
 
 
 @dataclass
 class ClientConfig:
+    """Genesis strategy precedence mirrors ClientGenesis
+    (client/src/config.rs:21-43): CheckpointSyncUrl > WeakSubjSszBytes
+    (checkpoint_state_ssz+checkpoint_block_ssz) > GenesisState ssz >
+    FromStore (resume, when the datadir already has a head) > Interop."""
+
     preset: str = "minimal"                  # "mainnet" | "minimal"
     datadir: Optional[str] = None            # None => memory store
     n_interop_validators: int = 64
     genesis_time: int = 1_600_000_000
-    genesis_state_ssz: Optional[bytes] = None  # checkpoint-sync anchor state
+    genesis_state_ssz: Optional[bytes] = None  # full genesis state
+    checkpoint_sync_url: Optional[str] = None  # ClientGenesis::CheckpointSyncUrl
+    checkpoint_state_ssz: Optional[bytes] = None  # ClientGenesis::WeakSubjSszBytes
+    checkpoint_block_ssz: Optional[bytes] = None
+    resume: bool = True                      # ClientGenesis::FromStore on restart
     http_port: Optional[int] = None          # None => no API server
     bls_backend: Optional[str] = None        # None => oracle; "tpu" => device
     mock_el: bool = True
@@ -120,11 +135,42 @@ class ClientBuilder:
             store = HotColdDB(types, spec)
 
         # --- genesis strategy (config.rs:21-43 ClientGenesis) ------------
-        if cfg.genesis_state_ssz is not None:
-            fork = ForkName.CAPELLA
-            genesis_state = types.BeaconState[fork].deserialize(
-                cfg.genesis_state_ssz
-            )
+        anchor_block = None
+        state_ssz, block_ssz = cfg.checkpoint_state_ssz, cfg.checkpoint_block_ssz
+        if cfg.checkpoint_sync_url:
+            # CheckpointSyncUrl: pull the finalized state+block over the
+            # Beacon API (builder.rs:157-330).
+            from lighthouse_tpu.common.eth2_client import BeaconNodeHttpClient
+
+            remote = BeaconNodeHttpClient(cfg.checkpoint_sync_url)
+            # Block first, then its exact post-state by root — the remote's
+            # finalized checkpoint may advance between the two requests.
+            block_ssz = remote.get_block_ssz("finalized")
+            anchor_state_root = block_ssz[
+                4 + 96 + 8 + 8 + 32:4 + 96 + 8 + 8 + 32 + 32
+            ]  # offset|sig|slot|proposer|parent_root|STATE_ROOT
+            state_ssz = remote.get_state_ssz("0x" + anchor_state_root.hex())
+        if state_ssz is not None:
+            genesis_state = types.BeaconState[
+                fork_for_state_ssz(spec, state_ssz)
+            ].deserialize(state_ssz)
+            if block_ssz is not None:
+                anchor_block = types.SignedBeaconBlock[
+                    fork_for_block_ssz(spec, block_ssz)
+                ].deserialize(block_ssz)
+        elif cfg.genesis_state_ssz is not None:
+            genesis_state = types.BeaconState[
+                fork_for_state_ssz(spec, cfg.genesis_state_ssz)
+            ].deserialize(cfg.genesis_state_ssz)
+        elif cfg.resume and (head := store.get_head_info()) is not None:
+            # FromStore: resume at the persisted head. The chain re-anchors
+            # fork choice at the stored head snapshot (competing pre-restart
+            # fork tips re-enter via sync, as after any checkpoint anchor).
+            head_root, head_state_root = head
+            genesis_state = store.get_state(head_state_root)
+            if genesis_state is None:
+                raise RuntimeError("datadir has a head pointer but no state")
+            anchor_block = store.get_block(head_root)
         else:
             keys = genesis_mod.generate_deterministic_keypairs(
                 cfg.n_interop_validators
@@ -155,6 +201,7 @@ class ClientBuilder:
             bls_backend=cfg.bls_backend,
             execution_layer=execution_layer,
             op_pool=op_pool,
+            anchor_block=anchor_block,
         )
         if cfg.real_clock:
             chain.slot_clock = SystemTimeSlotClock(

@@ -36,14 +36,30 @@ class BeaconNodeHttpClient:
         )
         return self._do(req)
 
-    def _do(self, req) -> Any:
+    def _do(self, req, raw: bool = False) -> Any:
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return json.loads(resp.read() or b"{}")
+                body = resp.read()
+                return body if raw else json.loads(body or b"{}")
         except urllib.error.HTTPError as e:
             raise Eth2ClientError(e.code, e.read().decode("utf-8", "replace"))
 
+    def _get_ssz(self, path: str) -> bytes:
+        req = urllib.request.Request(
+            self.base_url + path,
+            headers={"Accept": "application/octet-stream"},
+        )
+        return self._do(req, raw=True)
+
     # ------------------------------------------------------------- endpoints
+
+    def get_state_ssz(self, state_id: str = "finalized") -> bytes:
+        """Debug-API SSZ state download — the checkpoint-sync source
+        (get_debug_beacon_states in the reference client)."""
+        return self._get_ssz(f"/eth/v2/debug/beacon/states/{state_id}")
+
+    def get_block_ssz(self, block_id: str = "finalized") -> bytes:
+        return self._get_ssz(f"/eth/v2/beacon/blocks/{block_id}")
 
     def get_node_version(self) -> str:
         return self._get("/eth/v1/node/version")["data"]["version"]

@@ -77,6 +77,13 @@ class BeaconApiServer:
                 self.end_headers()
                 self.wfile.write(data)
 
+            def _reply_ssz(self, data: bytes) -> None:
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
             def _run(self, method: str) -> None:
                 parsed = urlparse(self.path)
                 try:
@@ -86,8 +93,12 @@ class BeaconApiServer:
                         outer._serve_events(self)
                         return
                     result = outer.dispatch(
-                        method, parsed.path, parse_qs(parsed.query), body
+                        method, parsed.path, parse_qs(parsed.query), body,
+                        accept=self.headers.get("Accept", ""),
                     )
+                    if isinstance(result, (bytes, bytearray)):
+                        self._reply_ssz(bytes(result))
+                        return
                     self._reply(200, result)
                 except ApiError as e:
                     self._reply(e.status, {"code": e.status, "message": e.message})
@@ -133,9 +144,22 @@ class BeaconApiServer:
     # ------------------------------------------------------------- dispatch
 
     def dispatch(self, method: str, path: str, query: Dict[str, List[str]],
-                 body) -> Dict[str, Any]:
+                 body, accept: str = "") -> Dict[str, Any]:
         chain = self.chain
         t, spec = chain.types, chain.spec
+        want_ssz = "application/octet-stream" in accept
+
+        # Debug state endpoint — the checkpoint-sync source (reference:
+        # http_api debug routes; client/src/builder.rs:157-330 fetches the
+        # finalized state+block over exactly this API).
+        m = re.fullmatch(r"/eth/v2/debug/beacon/states/([^/]+)", path)
+        if m:
+            state = self._state_by_id(m.group(1))
+            fork = chain.fork_at(state.slot)
+            if want_ssz:
+                return t.BeaconState[fork].serialize(state)
+            return {"version": fork,
+                    "data": to_json(t.BeaconState[fork], state)}
 
         if path == "/eth/v1/node/version":
             return {"data": {"version": VERSION}}
@@ -234,6 +258,8 @@ class BeaconApiServer:
         if m:
             signed = self._block_by_id(m.group(1))
             fork = chain.fork_at(signed.message.slot)
+            if want_ssz:
+                return t.SignedBeaconBlock[fork].serialize(signed)
             return {
                 "version": fork,
                 "data": to_json(t.SignedBeaconBlock[fork], signed),
@@ -415,6 +441,8 @@ class BeaconApiServer:
         chain = self.chain
         if block_id == "head":
             block = chain.store.get_block(chain.head.block_root)
+        elif block_id == "finalized":
+            block = chain.store.get_block(chain.fork_choice.finalized.root)
         elif block_id.startswith("0x"):
             block = chain.store.get_block(bytes.fromhex(block_id[2:]))
         else:

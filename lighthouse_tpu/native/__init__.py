@@ -50,6 +50,17 @@ def load(name: str) -> ctypes.CDLL:
                     base + ["-o", tmp, src], check=True, capture_output=True
                 )
             os.replace(tmp, out)
-        lib = ctypes.CDLL(out)
+        try:
+            lib = ctypes.CDLL(out)
+        except OSError:
+            # Stale/foreign artifact (e.g. built with -march=native on
+            # another host): rebuild portable and retry.
+            tmp = out + ".tmp"
+            subprocess.run(
+                ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, src],
+                check=True, capture_output=True,
+            )
+            os.replace(tmp, out)
+            lib = ctypes.CDLL(out)
         _cache[name] = lib
         return lib

@@ -97,6 +97,7 @@ class AnchorInfo:
 _SPLIT_KEY = b"split"
 _ANCHOR_KEY = b"anchor"
 _GENESIS_BLOCK_ROOT_KEY = b"genesis_block_root"
+_HEAD_KEY = b"head"
 
 
 def _slot_key(slot: int) -> bytes:
@@ -226,6 +227,15 @@ class HotColdDB:
     def put_state(self, state_root: bytes, state) -> None:
         self.hot.do_atomically(self.state_put_ops(state_root, state))
 
+    def put_state_full(self, state_root: bytes, state) -> None:
+        """Unconditionally store the full SSZ state (anchor states must be
+        loadable without replay, whatever their slot)."""
+        ops = self.state_put_ops(state_root, state)
+        if not any(op[1] == DBColumn.BeaconState for op in ops):
+            ops.append(("put", DBColumn.BeaconState, state_root,
+                        self._serialize_state(state, self._fork_at_slot(state.slot))))
+        self.hot.do_atomically(ops)
+
     def get_hot_summary(self, state_root: bytes) -> Optional[HotStateSummary]:
         raw = self.hot.get(DBColumn.BeaconStateSummary, state_root)
         return HotStateSummary.from_bytes(raw) if raw else None
@@ -310,6 +320,15 @@ class HotColdDB:
 
     def put_anchor_info(self, anchor: AnchorInfo) -> None:
         self.hot.put(DBColumn.BeaconMeta, _ANCHOR_KEY, anchor.to_bytes())
+
+    def put_head_info(self, block_root: bytes, state_root: bytes) -> None:
+        """Persisted head pointer — the restart-resume seam
+        (persisted_beacon_chain.rs analog; ClientGenesis::FromStore)."""
+        self.hot.put(DBColumn.BeaconMeta, _HEAD_KEY, block_root + state_root)
+
+    def get_head_info(self) -> Optional[Tuple[bytes, bytes]]:
+        raw = self.hot.get(DBColumn.BeaconMeta, _HEAD_KEY)
+        return (raw[:32], raw[32:64]) if raw else None
 
     def put_genesis_block_root(self, root: bytes) -> None:
         self.hot.put(DBColumn.BeaconMeta, _GENESIS_BLOCK_ROOT_KEY, root)

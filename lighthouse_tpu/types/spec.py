@@ -240,6 +240,22 @@ class ChainSpec:
         return epoch * self.preset.SLOTS_PER_EPOCH
 
 
+def fork_for_state_ssz(spec: "ChainSpec", data: bytes) -> str:
+    """Fork of a serialized BeaconState, sniffed from its fixed-offset slot
+    field (genesis_time u64 | genesis_validators_root 32B | slot u64). Lets
+    checkpoint-sync anchors deserialize without out-of-band fork info
+    (reference: fork-versioned SSZ responses of the debug state API)."""
+    slot = int.from_bytes(data[40:48], "little")
+    return spec.fork_name_at_epoch(spec.epoch_at_slot(slot))
+
+
+def fork_for_block_ssz(spec: "ChainSpec", data: bytes) -> str:
+    """Fork of a serialized SignedBeaconBlock: 4-byte offset to `message`,
+    96-byte signature, then the block whose first field is its slot."""
+    slot = int.from_bytes(data[100:108], "little")
+    return spec.fork_name_at_epoch(spec.epoch_at_slot(slot))
+
+
 def mainnet_spec() -> ChainSpec:
     return ChainSpec()
 
