@@ -89,7 +89,10 @@ class BeaconChain:
 
         self.genesis_block_root = genesis_block_root
         self.store.put_state_full(genesis_state_root, genesis_state)
-        self.store.put_genesis_block_root(genesis_block_root)
+        if self.store.get_genesis_block_root() is None:
+            # First boot only: a resumed store keeps its true genesis root
+            # (the anchor here is the resumed head, not genesis).
+            self.store.put_genesis_block_root(genesis_block_root)
 
         if anchor_block is not None:
             blk_cls = types.BeaconBlock[self.spec.fork_name_at_epoch(
@@ -100,16 +103,20 @@ class BeaconChain:
                     "anchor block does not match anchor state's latest header"
                 )
             self.store.put_block(genesis_block_root, anchor_block)
+            parent_root = bytes(anchor_block.message.parent_root)
             if self.store.get_anchor_info() is None and \
-                    anchor_block.message.slot > 0:
-                # Fresh checkpoint anchor: record the backfill frontier.
-                # (A resumed store keeps its existing frontier.)
+                    anchor_block.message.slot > 0 and \
+                    not self.store.block_exists(parent_root):
+                # Fresh checkpoint anchor (history genuinely absent): record
+                # the backfill frontier. A resumed store keeps its frontier;
+                # a genesis-synced node resuming at its head has the parent
+                # on disk and needs none.
                 from lighthouse_tpu.store.hot_cold import AnchorInfo
 
                 self.store.put_anchor_info(AnchorInfo(
                     anchor_slot=genesis_state.slot,
                     oldest_block_slot=anchor_block.message.slot,
-                    oldest_block_parent=bytes(anchor_block.message.parent_root),
+                    oldest_block_parent=parent_root,
                 ))
 
         cp = CheckpointSnapshot(
@@ -272,6 +279,7 @@ class BeaconChain:
             self.recompute_head()
             self.store.put_head_info(self.head.block_root,
                                      self.head.state_root or state_root)
+            self.update_execution_engine_forkchoice()
             if self.fork_choice.finalized.epoch > prev_finalized:
                 self._on_finalization()
             return root
@@ -523,6 +531,106 @@ class BeaconChain:
             )
             block.state_root = t.BeaconState[fork].hash_tree_root(post)
             return block, post
+
+    # ------------------------------------------------- payload invalidation
+
+    def process_invalid_execution_payload(
+        self, exec_block_hash: bytes,
+        latest_valid_hash: Optional[bytes] = None,
+    ) -> bool:
+        """EL said INVALID: poison the branch in proto-array and retreat the
+        head off it (fork_revert + payload invalidation semantics). Returns
+        True when the head moved."""
+        with self._lock:
+            self.fork_choice.proto.on_invalid_payload(
+                exec_block_hash, latest_valid_hash,
+                protected_roots=(self.fork_choice.justified.root,
+                                 self.fork_choice.finalized.root),
+            )
+            prev = self.head.block_root
+            return self.recompute_head() != prev
+
+    def update_execution_engine_forkchoice(self) -> None:
+        """Push the current head/finalized to the EL (forkchoiceUpdated after
+        head recompute); an INVALID verdict triggers head retreat and a
+        renewed notification, bounded (canonical_head's fcU + the invalid-
+        head handling of process_invalid_execution_payload)."""
+        if self.execution_layer is None:
+            return
+        proto = self.fork_choice.proto
+        for _ in range(8):
+            idx = proto.index_by_root.get(self.head.block_root)
+            if idx is None:
+                return
+            head_hash = proto.nodes[idx].execution_block_hash
+            if not head_hash:
+                return  # pre-merge head: nothing to tell the EL
+            fin_idx = proto.index_by_root.get(self.fork_choice.finalized.root)
+            fin_hash = (proto.nodes[fin_idx].execution_block_hash
+                        if fin_idx is not None else None) or b"\x00" * 32
+            jus_idx = proto.index_by_root.get(self.fork_choice.justified.root)
+            safe_hash = (proto.nodes[jus_idx].execution_block_hash
+                         if jus_idx is not None else None) or b"\x00" * 32
+            out = self.execution_layer.notify_forkchoice_updated(
+                head_hash, safe_hash, fin_hash
+            ) or {}
+            ps = out.get("payloadStatus") or {}
+            if ps.get("status") == "INVALID":
+                lvh = ps.get("latestValidHash")
+                moved = self.process_invalid_execution_payload(
+                    head_hash,
+                    bytes.fromhex(lvh[2:]) if isinstance(lvh, str) else lvh,
+                )
+                if not moved:
+                    return
+                continue  # re-notify for the retreated head
+            if ps.get("status") == "VALID":
+                proto.on_execution_status(head_hash, valid=True)
+            return
+
+    def reverify_optimistic_payloads(self) -> int:
+        """Re-submit optimistically imported payloads to the EL and apply its
+        verdicts — the OTB verification service loop
+        (otb_verification_service.rs), generalized to every optimistic node.
+        Returns how many verdicts were applied."""
+        if self.execution_layer is None or \
+                not self.execution_layer.engine_online:
+            return 0
+        applied = 0
+        with self._lock:
+            roots = self.fork_choice.proto.optimistic_roots()
+        for root in roots:
+            block = self.store.get_block(root)
+            if block is None or not hasattr(block.message.body,
+                                            "execution_payload"):
+                continue
+            status, lvh = self.execution_layer.verify_payload(
+                block.message.body.execution_payload
+            )
+            exec_hash = bytes(block.message.body.execution_payload.block_hash)
+            with self._lock:
+                if status == "VALID":
+                    self.fork_choice.proto.on_execution_status(
+                        exec_hash, valid=True
+                    )
+                    applied += 1
+                elif status == "INVALID":
+                    if lvh is not None:
+                        self.process_invalid_execution_payload(exec_hash, lvh)
+                    else:
+                        # No provenance: a newPayload INVALID condemns only
+                        # this payload and its descendants — still-optimistic
+                        # ancestors may yet prove valid.
+                        self.fork_choice.proto.on_execution_status(
+                            exec_hash, valid=False
+                        )
+                        self.recompute_head()
+                    applied += 1
+        return applied
+
+    @property
+    def head_is_optimistic(self) -> bool:
+        return self.fork_choice.proto.is_optimistic(self.head.block_root)
 
     # ----------------------------------------------------------------- head
 

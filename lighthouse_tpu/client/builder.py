@@ -30,6 +30,7 @@ from lighthouse_tpu.types.spec import (
     fork_for_state_ssz,
     mainnet_spec,
     minimal_spec,
+    state_root_of_block_ssz,
 )
 
 
@@ -108,6 +109,10 @@ class Client:
 
     def run_slot_tick(self, slot: int) -> None:
         self.chain.recompute_head()
+        # OTB re-verification: optimistically imported payloads get their
+        # EL verdicts applied once the engine responds
+        # (otb_verification_service.rs cadence = per-slot).
+        self.chain.reverify_optimistic_payloads()
         if self.chain.op_pool is not None:
             self.chain.op_pool.prune_attestations(
                 self.chain.spec.epoch_at_slot(slot)
@@ -146,9 +151,7 @@ class ClientBuilder:
             # Block first, then its exact post-state by root — the remote's
             # finalized checkpoint may advance between the two requests.
             block_ssz = remote.get_block_ssz("finalized")
-            anchor_state_root = block_ssz[
-                4 + 96 + 8 + 8 + 32:4 + 96 + 8 + 8 + 32 + 32
-            ]  # offset|sig|slot|proposer|parent_root|STATE_ROOT
+            anchor_state_root = state_root_of_block_ssz(block_ssz)
             state_ssz = remote.get_state_ssz("0x" + anchor_state_root.hex())
         if state_ssz is not None:
             genesis_state = types.BeaconState[

@@ -45,19 +45,29 @@ class ExecutionLayer:
 
     def notify_new_payload(self, payload) -> str:
         """-> "VALID" | "INVALID" | "SYNCING" (payload_status.rs collapse)."""
+        return self.verify_payload(payload)[0]
+
+    def verify_payload(self, payload):
+        """-> (status, latest_valid_hash | None); the hash carries the
+        INVALID verdict's provenance for targeted invalidation."""
         with self._lock:
             try:
                 status = self.engine.new_payload(payload)
                 self.engine_online = True
             except EngineApiError:
                 self.engine_online = False
-                return "SYNCING"  # EL offline => optimistic import
+                return "SYNCING", None  # EL offline => optimistic import
         s = status.get("status", "SYNCING")
+        lvh = status.get("latestValidHash")
+        if isinstance(lvh, str):
+            lvh = bytes.fromhex(lvh[2:])
+        if lvh == b"\x00" * 32:
+            lvh = None
         if s in ("VALID",):
-            return "VALID"
+            return "VALID", lvh
         if s in ("INVALID", "INVALID_BLOCK_HASH"):
-            return "INVALID"
-        return "SYNCING"  # SYNCING | ACCEPTED
+            return "INVALID", lvh
+        return "SYNCING", None  # SYNCING | ACCEPTED
 
     # ------------------------------------------------------------ forkchoice
 

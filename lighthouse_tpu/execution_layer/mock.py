@@ -44,29 +44,39 @@ class MockExecutionEngine:
         self._job_seq = 0
         # Test hooks: set to force statuses (test_utils/hook.rs).
         self.on_new_payload: Optional[Any] = None
+        self.on_forkchoice_updated: Optional[Any] = None
         self.genesis_hash = terminal_block_hash
         self.blocks[terminal_block_hash] = {"blockNumber": "0x0", "blockHash": "0x" + terminal_block_hash.hex()}
 
     # ----------------------------------------------------------- engine API
 
     def new_payload(self, payload) -> Dict[str, Any]:
-        if self.on_new_payload is not None:
-            forced = self.on_new_payload(payload)
-            if forced is not None:
-                return {"status": forced}
+        """The hook (test_utils/hook.rs) overrides only the RESPONSE; the
+        block generator still records a structurally valid payload, so
+        chains keep extending during forced-SYNCING scenarios."""
         with self._lock:
             obj = payload_to_json(payload)
             parent = bytes(payload.parent_hash)
             if parent not in self.blocks:
-                return {"status": "SYNCING"}
-            if bytes(payload.block_hash) != compute_block_hash(obj):
-                return {"status": "INVALID_BLOCK_HASH"}
-            self.blocks[bytes(payload.block_hash)] = obj
-            return {"status": "VALID",
-                    "latestValidHash": "0x" + bytes(payload.block_hash).hex()}
+                result = {"status": "SYNCING"}
+            elif bytes(payload.block_hash) != compute_block_hash(obj):
+                result = {"status": "INVALID_BLOCK_HASH"}
+            else:
+                self.blocks[bytes(payload.block_hash)] = obj
+                result = {"status": "VALID",
+                          "latestValidHash": "0x" + bytes(payload.block_hash).hex()}
+        if self.on_new_payload is not None:
+            forced = self.on_new_payload(payload)
+            if forced is not None:
+                return {"status": forced}
+        return result
 
     def forkchoice_updated(self, head: bytes, safe: bytes, fin: bytes,
                            attrs: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+        if self.on_forkchoice_updated is not None:
+            forced = self.on_forkchoice_updated(head, safe, fin, attrs)
+            if forced is not None:
+                return forced
         with self._lock:
             head = bytes(head)
             if head not in self.blocks:

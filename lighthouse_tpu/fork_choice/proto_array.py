@@ -302,3 +302,50 @@ class ProtoArrayForkChoice:
                     invalid.add(i)
             for i in invalid:
                 self.nodes[i].execution_status = ExecutionStatus.INVALID
+
+    def on_invalid_payload(self, head_block_hash: bytes,
+                           latest_valid_hash: Optional[bytes] = None,
+                           protected_roots: tuple = ()) -> None:
+        """Engine INVALID verdict with provenance: every block from the one
+        carrying `head_block_hash` back to (exclusive) the one carrying
+        `latest_valid_hash` is invalid, plus all their descendants; the
+        latest-valid ancestor chain is ratified (payload invalidation
+        semantics of process_invalid_execution_payload in the reference).
+        Nodes in `protected_roots` (justified/finalized) are never
+        invalidated — the reference likewise refuses to invalidate at or
+        below the justified checkpoint."""
+        start = next(
+            (i for i, n in enumerate(self.nodes)
+             if n.execution_block_hash == head_block_hash), None,
+        )
+        if start is None:
+            return
+        invalid = set()
+        j: Optional[int] = start
+        while j is not None:
+            n = self.nodes[j]
+            if latest_valid_hash is not None and \
+                    n.execution_block_hash == latest_valid_hash:
+                self.on_execution_status(latest_valid_hash, valid=True)
+                break
+            if n.execution_status in (ExecutionStatus.IRRELEVANT,
+                                      ExecutionStatus.VALID):
+                break  # EL-ratified (or pre-merge) ancestor: stop there
+            if n.root in protected_roots:
+                break  # never invalidate the justified/finalized spine
+            invalid.add(j)
+            j = n.parent
+        for i in range(min(invalid, default=len(self.nodes)), len(self.nodes)):
+            if self.nodes[i].parent in invalid:
+                invalid.add(i)
+        for i in invalid:
+            self.nodes[i].execution_status = ExecutionStatus.INVALID
+
+    def is_optimistic(self, root: bytes) -> bool:
+        idx = self.index_by_root.get(root)
+        return idx is not None and \
+            self.nodes[idx].execution_status is ExecutionStatus.OPTIMISTIC
+
+    def optimistic_roots(self) -> List[bytes]:
+        return [n.root for n in self.nodes
+                if n.execution_status is ExecutionStatus.OPTIMISTIC]

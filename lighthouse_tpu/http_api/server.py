@@ -172,7 +172,7 @@ class BeaconApiServer:
                 "head_slot": str(head_slot),
                 "sync_distance": str(max(0, current - head_slot)),
                 "is_syncing": current > head_slot + 1,
-                "is_optimistic": False,
+                "is_optimistic": chain.head_is_optimistic,
                 "el_offline": bool(
                     chain.execution_layer is not None
                     and not chain.execution_layer.engine_online

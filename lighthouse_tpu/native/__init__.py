@@ -35,9 +35,9 @@ def load(name: str) -> ctypes.CDLL:
         src = os.path.join(_SRC, f"{name}.cpp")
         out = os.path.join(_BUILD, f"lib{name}.so")
         os.makedirs(_BUILD, exist_ok=True)
+        base = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC"]
         if _needs_build(src, out):
             tmp = out + ".tmp"
-            base = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC"]
             try:
                 # -march=native unlocks SHA-NI/AVX paths where guarded by
                 # #ifdef in the sources; fall back to portable codegen.
@@ -56,10 +56,8 @@ def load(name: str) -> ctypes.CDLL:
             # Stale/foreign artifact (e.g. built with -march=native on
             # another host): rebuild portable and retry.
             tmp = out + ".tmp"
-            subprocess.run(
-                ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, src],
-                check=True, capture_output=True,
-            )
+            subprocess.run(base + ["-o", tmp, src],
+                           check=True, capture_output=True)
             os.replace(tmp, out)
             lib = ctypes.CDLL(out)
         _cache[name] = lib

@@ -117,17 +117,29 @@ class BeaconChainHarness:
         proposer = h.get_beacon_proposer_index(state, spec)
         epoch = spec.epoch_at_slot(slot)
 
-        payload = types.ExecutionPayloadCapella(
-            parent_hash=state.latest_execution_payload_header.block_hash,
-            prev_randao=h.get_randao_mix(state, spec, epoch),
-            block_number=state.latest_execution_payload_header.block_number + 1,
-            timestamp=state.genesis_time + slot * spec.seconds_per_slot,
-            block_hash=hashlib.sha256(
-                bytes(state.latest_execution_payload_header.block_hash)
-                + slot.to_bytes(8, "little")
-            ).digest(),
-            withdrawals=bp.get_expected_withdrawals(state, types, spec),
-        )
+        if chain.execution_layer is not None:
+            # Build through the engine (two-phase fcU -> getPayload), so the
+            # payload satisfies the engine's own hash check on import.
+            payload = chain.execution_layer.get_payload(
+                parent_hash=bytes(
+                    state.latest_execution_payload_header.block_hash
+                ),
+                timestamp=state.genesis_time + slot * spec.seconds_per_slot,
+                prev_randao=h.get_randao_mix(state, spec, epoch),
+                withdrawals=bp.get_expected_withdrawals(state, types, spec),
+            )
+        else:
+            payload = types.ExecutionPayloadCapella(
+                parent_hash=state.latest_execution_payload_header.block_hash,
+                prev_randao=h.get_randao_mix(state, spec, epoch),
+                block_number=state.latest_execution_payload_header.block_number + 1,
+                timestamp=state.genesis_time + slot * spec.seconds_per_slot,
+                block_hash=hashlib.sha256(
+                    bytes(state.latest_execution_payload_header.block_hash)
+                    + slot.to_bytes(8, "little")
+                ).digest(),
+                withdrawals=bp.get_expected_withdrawals(state, types, spec),
+            )
         body = types.BeaconBlockBodyCapella(
             randao_reveal=self.randao_reveal(state, epoch, proposer),
             eth1_data=state.eth1_data,

@@ -256,6 +256,13 @@ def fork_for_block_ssz(spec: "ChainSpec", data: bytes) -> str:
     return spec.fork_name_at_epoch(spec.epoch_at_slot(slot))
 
 
+def state_root_of_block_ssz(data: bytes) -> bytes:
+    """state_root of a serialized SignedBeaconBlock (same fixed prefix as
+    fork_for_block_ssz: offset4 | signature96 | slot8 | proposer8 |
+    parent_root32 | STATE_ROOT32)."""
+    return data[148:180]
+
+
 def mainnet_spec() -> ChainSpec:
     return ChainSpec()
 

@@ -154,7 +154,10 @@ def test_chain_imports_optimistically_with_mock_el(types):
         h.chain.head_state_for_signatures(), signed.message, "capella"
     )
     h.chain.process_block(signed)
-    assert engine.head_hash == engine.genesis_hash  # fcU not yet driven
+    # Import drives forkchoiceUpdated: the engine's head follows the chain's.
+    assert engine.head_hash == bytes(
+        signed.message.body.execution_payload.block_hash
+    )
 
     # forced INVALID refuses import
     h2 = make_harness()

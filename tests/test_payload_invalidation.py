@@ -1,0 +1,171 @@
+"""Invalid-payload handling: head retreat off an invalidated branch, OTB
+re-verification of optimistic imports, fcU INVALID verdicts (reference:
+beacon_chain/tests/payload_invalidation.rs, fork_revert.rs,
+otb_verification_service.rs; mock-EL hooks from test_utils/hook.rs)."""
+
+from lighthouse_tpu.execution_layer import ExecutionLayer, MockExecutionEngine
+from lighthouse_tpu.fork_choice.proto_array import ExecutionStatus
+from lighthouse_tpu.testing.harness import BeaconChainHarness
+
+
+def _harness_with_el():
+    harness = BeaconChainHarness(n_validators=32, bls_backend="fake")
+    state = harness.chain.head.state
+    engine = MockExecutionEngine(
+        harness.types,
+        terminal_block_hash=bytes(
+            state.latest_execution_payload_header.block_hash
+        ),
+    )
+    el = ExecutionLayer(engine, types=harness.types)
+    harness.chain.execution_layer = el
+    return harness, engine, el
+
+
+def _force_syncing(engine, forced):
+    """While forced["on"], the engine answers SYNCING to verification calls
+    (newPayload and attribute-less fcU) but still builds payloads."""
+    engine.on_new_payload = \
+        lambda payload: "SYNCING" if forced["on"] else None
+    engine.on_forkchoice_updated = lambda head, safe, fin, attrs: (
+        {"payloadStatus": {"status": "SYNCING"}, "payloadId": None}
+        if forced["on"] and attrs is None else None
+    )
+
+
+def _exec_hash(chain, root):
+    return chain.fork_choice.proto.nodes[
+        chain.fork_choice.proto.index_by_root[root]
+    ].execution_block_hash
+
+
+def test_optimistic_import_then_valid_verdict():
+    """EL SYNCING at import => optimistic node; OTB re-verification ratifies
+    it once the EL answers VALID."""
+    harness, engine, el = _harness_with_el()
+    chain = harness.chain
+
+    forced = {"on": True}
+    _force_syncing(engine, forced)
+    roots = [r for r, _ in harness.extend_chain(2, attest=False)]
+    assert chain.fork_choice.proto.is_optimistic(roots[-1])
+    assert chain.head_is_optimistic
+
+    # EL comes alive: hook off, payloads re-verify VALID.
+    forced["on"] = False
+    applied = chain.reverify_optimistic_payloads()
+    assert applied == 2
+    assert not chain.head_is_optimistic
+    assert chain.fork_choice.proto.optimistic_roots() == []
+
+
+def test_invalid_payload_reverts_head():
+    """A branch invalidated by the EL loses the head to the last valid
+    block (fork revert)."""
+    harness, engine, el = _harness_with_el()
+    chain = harness.chain
+
+    good = [r for r, _ in harness.extend_chain(2, attest=False)]
+    good_head = chain.head.block_root
+    assert good_head == good[-1]
+
+    # Two more blocks imported optimistically (EL syncing).
+    forced = {"on": True}
+    _force_syncing(engine, forced)
+    bad = [r for r, _ in harness.extend_chain(2, attest=False)]
+    assert chain.head.block_root == bad[-1]
+
+    # The EL rules the first optimistic payload INVALID with the good head
+    # as latest-valid: the whole optimistic branch dies, head retreats.
+    moved = chain.process_invalid_execution_payload(
+        _exec_hash(chain, bad[0]),
+        latest_valid_hash=_exec_hash(chain, good_head),
+    )
+    assert moved
+    assert chain.head.block_root == good_head
+    proto = chain.fork_choice.proto
+    for r in bad:
+        assert proto.nodes[
+            proto.index_by_root[r]
+        ].execution_status is ExecutionStatus.INVALID
+    # Latest-valid ancestor chain ratified.
+    assert proto.nodes[
+        proto.index_by_root[good_head]
+    ].execution_status is ExecutionStatus.VALID
+
+
+def test_otb_reverification_invalidates():
+    """OTB loop applying an INVALID verdict retreats the head by itself."""
+    harness, engine, el = _harness_with_el()
+    chain = harness.chain
+    harness.extend_chain(1, attest=False)
+    good_head = chain.head.block_root
+
+    forced = {"on": True}
+    _force_syncing(engine, forced)
+    harness.extend_chain(2, attest=False)
+    assert chain.head_is_optimistic
+
+    engine.on_new_payload = lambda payload: "INVALID"
+    chain.reverify_optimistic_payloads()
+    assert chain.head.block_root == good_head
+    assert not chain.head_is_optimistic
+
+
+def test_invalidation_never_crosses_justified_checkpoint():
+    """An INVALID verdict with no provenance must not poison the justified/
+    finalized spine (the reference refuses to invalidate at or below the
+    justified checkpoint)."""
+    harness, engine, el = _harness_with_el()
+    chain = harness.chain
+    forced = {"on": True}
+    _force_syncing(engine, forced)
+    roots = [r for r, _ in harness.extend_chain(3, attest=False)]
+    # Pretend the middle of the optimistic chain got justified.
+    from lighthouse_tpu.fork_choice.fork_choice import CheckpointSnapshot
+
+    chain.fork_choice.justified = CheckpointSnapshot(
+        epoch=chain.fork_choice.justified.epoch, root=roots[1]
+    )
+    chain.process_invalid_execution_payload(_exec_hash(chain, roots[2]))
+    proto = chain.fork_choice.proto
+    assert proto.nodes[
+        proto.index_by_root[roots[2]]
+    ].execution_status is ExecutionStatus.INVALID
+    # The justified block and its ancestor survived.
+    for r in roots[:2]:
+        assert proto.nodes[
+            proto.index_by_root[r]
+        ].execution_status is ExecutionStatus.OPTIMISTIC
+
+
+def test_fcu_invalid_verdict_retreats_head():
+    """forkchoiceUpdated answering INVALID for the new head triggers the
+    same retreat (update_execution_engine_forkchoice loop)."""
+    harness, engine, el = _harness_with_el()
+    chain = harness.chain
+    harness.extend_chain(1, attest=False)
+    good_head = chain.head.block_root
+
+    # Import the next block optimistically, then make fcU call it INVALID.
+    forced = {"on": True}
+    _force_syncing(engine, forced)
+    bad_root, _ = harness.extend_chain(1, attest=False)[0]
+    forced["on"] = False
+    engine.on_new_payload = None
+    bad_hash = _exec_hash(chain, bad_root)
+    lvh = _exec_hash(chain, good_head)
+
+    real_fcu = engine.forkchoice_updated
+
+    def invalid_fcu(head, safe, fin, attrs):
+        if bytes(head) == bad_hash:
+            return {"payloadStatus": {
+                "status": "INVALID",
+                "latestValidHash": "0x" + lvh.hex(),
+            }, "payloadId": None}
+        return real_fcu(head, safe, fin, attrs)
+
+    engine.forkchoice_updated = invalid_fcu
+    chain.update_execution_engine_forkchoice()
+    assert chain.head.block_root == good_head
