@@ -231,13 +231,17 @@ class BeaconChain:
             gossip = blk_ver.gossip_verify_block(self, signed_block)
             sig = blk_ver.signature_verify_block(self, gossip)
             pending = blk_ver.into_execution_pending_block(self, sig)
-            return self.import_block(pending)
+            root = self.import_block(pending)
+        self.update_execution_engine_forkchoice()
+        return root
 
     def process_block_from_segment(self, sig_verified) -> bytes:
         """Import one signature-verified block of a range segment."""
         with self._lock:
             pending = blk_ver.into_execution_pending_block(self, sig_verified)
-            return self.import_block(pending)
+            root = self.import_block(pending)
+        self.update_execution_engine_forkchoice()
+        return root
 
     def import_block(self, pending) -> bytes:
         """fork choice + store + head update (import_available_block :3023)."""
@@ -279,9 +283,11 @@ class BeaconChain:
             self.recompute_head()
             self.store.put_head_info(self.head.block_root,
                                      self.head.state_root or state_root)
-            self.update_execution_engine_forkchoice()
             if self.fork_choice.finalized.epoch > prev_finalized:
                 self._on_finalization()
+            # NB: fcU to the engine is issued by the process_block* callers
+            # AFTER the lock drops — engine round-trips must not stall the
+            # import critical section.
             return root
 
     def _apply_block_attestations_to_fork_choice(self, block, state, current_slot):
@@ -421,10 +427,13 @@ class BeaconChain:
         slot: int,
         randao_reveal: bytes,
         graffiti: bytes = b"\x00" * 32,
+        blinded: bool = False,
     ):
         """Assemble an unsigned block on the current head: pool attestations
         via max-cover, slashings/exits, execution payload from the EL (or an
         empty self-built one) (produce_block_with_verification :4092).
+        With `blinded`, the payload is a builder bid's header and the result
+        is a BlindedBeaconBlock (the builder branch of lib.rs:785).
         Returns (block, post_state); the caller signs."""
         from lighthouse_tpu.crypto.bls import api as bls
         from lighthouse_tpu.state_transition import block_processing as bp
@@ -469,7 +478,21 @@ class BeaconChain:
                     self.op_pool.get_slashings_and_exits(state)
                 bls_changes = self.op_pool.get_bls_to_execution_changes(state)
 
-            if self.execution_layer is not None:
+            payload_header = None
+            if blinded:
+                if self.execution_layer is None or \
+                        self.execution_layer.builder is None:
+                    raise RuntimeError("blinded production requires a builder")
+                proposer_i = h.get_beacon_proposer_index(state, spec)
+                pk = self.pubkey_cache.get(proposer_i)
+                signed_bid = self.execution_layer.builder.get_header(
+                    slot,
+                    bytes(state.latest_execution_payload_header.block_hash),
+                    pk.to_bytes() if pk is not None else b"\x00" * 48,
+                )
+                payload_header = signed_bid.message.header
+                payload = None
+            elif self.execution_layer is not None:
                 payload = self.execution_layer.get_payload(
                     parent_hash=bytes(
                         state.latest_execution_payload_header.block_hash
@@ -501,7 +524,7 @@ class BeaconChain:
             sync_aggregate = self.sync_contribution_pool.best_sync_aggregate(
                 max(slot, 1) - 1, parent_root
             )
-            body = t.BeaconBlockBodyCapella(
+            common = dict(
                 randao_reveal=randao_reveal,
                 eth1_data=state.eth1_data,
                 graffiti=graffiti,
@@ -511,10 +534,23 @@ class BeaconChain:
                 deposits=deposits,
                 voluntary_exits=exits,
                 sync_aggregate=sync_aggregate,
-                execution_payload=payload,
                 bls_to_execution_changes=bls_changes,
             )
-            block = t.BeaconBlock[fork](
+            if payload_header is not None:
+                body = t.BlindedBeaconBlockBody[fork](
+                    execution_payload_header=payload_header, **common
+                )
+                block_cls, signed_cls = (
+                    t.BlindedBeaconBlock[fork], t.SignedBlindedBeaconBlock[fork]
+                )
+            else:
+                body = t.BeaconBlockBody[fork](
+                    execution_payload=payload, **common
+                )
+                block_cls, signed_cls = (
+                    t.BeaconBlock[fork], t.SignedBeaconBlock[fork]
+                )
+            block = block_cls(
                 slot=slot,
                 proposer_index=proposer,
                 parent_root=parent_root,
@@ -522,9 +558,7 @@ class BeaconChain:
                 body=body,
             )
             post = state
-            unsigned = t.SignedBeaconBlock[fork](
-                message=block, signature=b"\x00" * 96
-            )
+            unsigned = signed_cls(message=block, signature=b"\x00" * 96)
             bp.per_block_processing(
                 post, t, spec, unsigned, fork,
                 verify_signatures=bp.VerifySignatures.FALSE,
@@ -553,24 +587,31 @@ class BeaconChain:
     def update_execution_engine_forkchoice(self) -> None:
         """Push the current head/finalized to the EL (forkchoiceUpdated after
         head recompute); an INVALID verdict triggers head retreat and a
-        renewed notification, bounded (canonical_head's fcU + the invalid-
-        head handling of process_invalid_execution_payload)."""
+        renewed notification, bounded. The engine round-trip runs WITHOUT
+        the chain lock (a slow EL must not stall imports/production); the
+        lock is re-taken only to apply verdicts — matching the reference,
+        where fcU happens outside block import's critical section."""
         if self.execution_layer is None:
             return
         proto = self.fork_choice.proto
         for _ in range(8):
-            idx = proto.index_by_root.get(self.head.block_root)
-            if idx is None:
-                return
-            head_hash = proto.nodes[idx].execution_block_hash
-            if not head_hash:
-                return  # pre-merge head: nothing to tell the EL
-            fin_idx = proto.index_by_root.get(self.fork_choice.finalized.root)
-            fin_hash = (proto.nodes[fin_idx].execution_block_hash
-                        if fin_idx is not None else None) or b"\x00" * 32
-            jus_idx = proto.index_by_root.get(self.fork_choice.justified.root)
-            safe_hash = (proto.nodes[jus_idx].execution_block_hash
-                         if jus_idx is not None else None) or b"\x00" * 32
+            with self._lock:
+                idx = proto.index_by_root.get(self.head.block_root)
+                if idx is None:
+                    return
+                head_hash = proto.nodes[idx].execution_block_hash
+                if not head_hash:
+                    return  # pre-merge head: nothing to tell the EL
+                fin_idx = proto.index_by_root.get(
+                    self.fork_choice.finalized.root
+                )
+                fin_hash = (proto.nodes[fin_idx].execution_block_hash
+                            if fin_idx is not None else None) or b"\x00" * 32
+                jus_idx = proto.index_by_root.get(
+                    self.fork_choice.justified.root
+                )
+                safe_hash = (proto.nodes[jus_idx].execution_block_hash
+                             if jus_idx is not None else None) or b"\x00" * 32
             out = self.execution_layer.notify_forkchoice_updated(
                 head_hash, safe_hash, fin_hash
             ) or {}
@@ -585,7 +626,8 @@ class BeaconChain:
                     return
                 continue  # re-notify for the retreated head
             if ps.get("status") == "VALID":
-                proto.on_execution_status(head_hash, valid=True)
+                with self._lock:
+                    proto.on_execution_status(head_hash, valid=True)
             return
 
     def reverify_optimistic_payloads(self) -> int:

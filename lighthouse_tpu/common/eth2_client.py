@@ -90,6 +90,19 @@ class BeaconNodeHttpClient:
     def publish_block(self, signed_block_json: Dict[str, Any]) -> None:
         self._post("/eth/v1/beacon/blocks", signed_block_json)
 
+    def get_blinded_block_proposal(self, slot: int,
+                                   randao_reveal: bytes) -> Dict[str, Any]:
+        return self._get(
+            f"/eth/v1/validator/blinded_blocks/{slot}",
+            {"randao_reveal": "0x" + randao_reveal.hex()},
+        )
+
+    def publish_blinded_block(self, signed_json: Dict[str, Any]) -> None:
+        self._post("/eth/v1/beacon/blinded_blocks", signed_json)
+
+    def register_validator(self, registrations: List[Dict[str, Any]]) -> None:
+        self._post("/eth/v1/validator/register_validator", registrations)
+
     def get_proposer_duties(self, epoch: int) -> List[Dict[str, Any]]:
         return self._get(f"/eth/v1/validator/duties/proposer/{epoch}")["data"]
 

@@ -1,0 +1,338 @@
+"""External block builder (MEV) flow.
+
+Reference counterparts: `beacon_node/builder_client` (the BN-side HTTP
+client), `execution_layer/src/test_utils/mock_builder.rs` (a builder that
+wraps an execution engine and serves signed bids), and the blinded payload
+branch of `ExecutionLayer::get_payload` (execution_layer/src/lib.rs:785).
+
+Flow:
+  1. BN asks `GET /eth/v1/builder/header/{slot}/{parent_hash}/{pubkey}` —
+     the builder assembles a payload through its own engine, withholds it,
+     and returns a `SignedBuilderBid{header, value, pubkey}` signed with the
+     builder's key under DOMAIN_APPLICATION_BUILDER (genesis fork version,
+     zero genesis_validators_root — the builder-spec domain).
+  2. The proposer signs the resulting BlindedBeaconBlock (root-identical to
+     the full block).
+  3. BN posts it to `POST /eth/v1/builder/blinded_blocks`; the builder
+     reveals the full ExecutionPayload, which the BN un-blinds and imports.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+from urllib import request as _urlreq
+
+from lighthouse_tpu.crypto.bls import api as bls
+from lighthouse_tpu.types.spec import (
+    DOMAIN_APPLICATION_BUILDER,
+    compute_domain,
+    compute_signing_root,
+)
+
+from .engine_api import json_to_payload, payload_to_json
+
+
+class BuilderError(Exception):
+    pass
+
+
+class MockBuilder:
+    """A builder wrapping an execution engine: builds real payloads, serves
+    bids, reveals payloads on submission (mock_builder.rs)."""
+
+    def __init__(self, el, types, spec, secret_key: Optional[int] = None,
+                 fork: str = "capella"):
+        self.el = el  # ExecutionLayer driving the builder's own engine
+        self.types = types
+        self.spec = spec
+        self.fork = fork
+        self.sk = bls.SecretKey(secret_key or 0x42B17D)
+        self.pubkey = self.sk.public_key()
+        self._payloads: Dict[bytes, object] = {}  # block_hash -> payload
+        self._registrations: Dict[bytes, dict] = {}  # pubkey -> registration
+        # Test knobs (mock_builder.rs Operation): adjust bid value, serve a
+        # corrupt header, or refuse to reveal.
+        self.bid_value: int = 1_000_000_000
+        self.corrupt_parent_hash = False
+        self.refuse_reveal = False
+
+    # ------------------------------------------------------------- endpoints
+
+    def register_validators(self, registrations) -> None:
+        for reg in registrations:
+            self._registrations[bytes.fromhex(
+                reg["message"]["pubkey"][2:]
+            )] = reg
+
+    def get_header(self, slot: int, parent_hash: bytes, pubkey: bytes):
+        """-> SignedBuilderBid (JSON-able dict)."""
+        from lighthouse_tpu.state_transition.block_processing import (
+            get_expected_withdrawals,
+            payload_to_header,
+        )
+
+        t = self.types
+        # The mock builds on whatever chain context the caller supplies via
+        # attributes; slot timing mirrors the local production path.
+        chain = getattr(self, "chain", None)
+        if chain is not None:
+            state = chain.head_state_clone_at(slot)
+            from lighthouse_tpu.state_transition import helpers as h
+            from lighthouse_tpu.state_transition import slot_processing as sp
+
+            if state.slot < slot:
+                state = state.copy()
+                state = sp.process_slots(state, t, self.spec, slot)
+            prev_randao = h.get_randao_mix(
+                state, self.spec, self.spec.epoch_at_slot(slot)
+            )
+            withdrawals = get_expected_withdrawals(state, t, self.spec)
+            timestamp = state.genesis_time + slot * self.spec.seconds_per_slot
+        else:
+            prev_randao = b"\x00" * 32
+            withdrawals = []
+            timestamp = slot
+
+        payload = self.el.get_payload(
+            parent_hash=parent_hash,
+            timestamp=timestamp,
+            prev_randao=prev_randao,
+            withdrawals=withdrawals,
+        )
+        self._payloads[bytes(payload.block_hash)] = payload
+        header = payload_to_header(t, self.spec, payload, self.fork)
+        if self.corrupt_parent_hash:
+            header.parent_hash = b"\xde" * 32
+        bid = t.BuilderBid[self.fork](
+            header=header, value=self.bid_value,
+            pubkey=self.pubkey.to_bytes(),
+        )
+        domain = compute_domain(
+            DOMAIN_APPLICATION_BUILDER,
+            self.spec.genesis_fork_version, b"\x00" * 32,
+        )
+        root = compute_signing_root(bid, t.BuilderBid[self.fork], domain)
+        sig = self.sk.sign(root)
+        signed = t.SignedBuilderBid[self.fork](
+            message=bid, signature=sig.to_bytes()
+        )
+        return signed
+
+    def submit_blinded_block(self, signed_blinded):
+        """Reveal the payload for an accepted bid. Accepts the signed
+        blinded block JSON (the BuilderHttpClient signature) or a raw
+        header block hash."""
+        if self.refuse_reveal:
+            raise BuilderError("builder refused to reveal payload")
+        if isinstance(signed_blinded, dict):
+            block_hash = bytes.fromhex(
+                signed_blinded["message"]["body"]
+                ["execution_payload_header"]["block_hash"][2:]
+            )
+        else:
+            block_hash = bytes(signed_blinded)
+        payload = self._payloads.get(block_hash)
+        if payload is None:
+            raise BuilderError("unknown payload for submitted blinded block")
+        return payload
+
+
+def verify_builder_bid(types, spec, signed_bid, fork: str) -> bool:
+    """BN-side bid signature check (builder pubkey is in the bid)."""
+    domain = compute_domain(
+        DOMAIN_APPLICATION_BUILDER, spec.genesis_fork_version, b"\x00" * 32
+    )
+    root = compute_signing_root(
+        signed_bid.message, types.BuilderBid[fork], domain
+    )
+    pk = bls.PublicKey.from_bytes(bytes(signed_bid.message.pubkey))
+    sig = bls.Signature.from_bytes(bytes(signed_bid.signature))
+    return bls.verify(pk, root, sig)
+
+
+# ---------------------------------------------------------------------------
+# HTTP layer (builder API is a real process boundary in the reference)
+# ---------------------------------------------------------------------------
+
+
+class MockBuilderServer:
+    """Serve a MockBuilder over the builder REST API."""
+
+    def __init__(self, builder: MockBuilder, port: int = 0):
+        self.builder = builder
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, status: int, body) -> None:
+                data = json.dumps(body).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                try:
+                    parts = self.path.strip("/").split("/")
+                    # eth/v1/builder/header/{slot}/{parent_hash}/{pubkey}
+                    if parts[:4] == ["eth", "v1", "builder", "header"]:
+                        slot = int(parts[4])
+                        parent_hash = bytes.fromhex(parts[5][2:])
+                        pubkey = bytes.fromhex(parts[6][2:])
+                        signed = outer.builder.get_header(
+                            slot, parent_hash, pubkey
+                        )
+                        t = outer.builder.types
+                        fork = outer.builder.fork
+                        self._reply(200, {
+                            "version": fork,
+                            "data": {
+                                "message": {
+                                    "header": _header_to_json(
+                                        signed.message.header
+                                    ),
+                                    "value": str(signed.message.value),
+                                    "pubkey": "0x" + bytes(
+                                        signed.message.pubkey
+                                    ).hex(),
+                                },
+                                "signature": "0x" + bytes(
+                                    signed.signature
+                                ).hex(),
+                            },
+                        })
+                        return
+                    if parts[:4] == ["eth", "v1", "builder", "status"]:
+                        self._reply(200, {})
+                        return
+                    self._reply(404, {"message": "unknown route"})
+                except Exception as e:
+                    self._reply(500, {"message": repr(e)})
+
+            def do_POST(self):
+                try:
+                    length = int(self.headers.get("Content-Length", 0) or 0)
+                    body = json.loads(self.rfile.read(length)) if length else None
+                    parts = self.path.strip("/").split("/")
+                    if parts[:4] == ["eth", "v1", "builder", "validators"]:
+                        outer.builder.register_validators(body)
+                        self._reply(200, {})
+                        return
+                    if parts[:4] == ["eth", "v1", "builder", "blinded_blocks"]:
+                        payload = outer.builder.submit_blinded_block(body)
+                        self._reply(200, {
+                            "version": outer.builder.fork,
+                            "data": payload_to_json(payload),
+                        })
+                        return
+                    self._reply(404, {"message": "unknown route"})
+                except BuilderError as e:
+                    self._reply(400, {"message": str(e)})
+                except Exception as e:
+                    self._reply(500, {"message": repr(e)})
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self.server.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def _header_to_json(hdr) -> dict:
+    out = {}
+    for name, _ in type(hdr).FIELDS:
+        v = getattr(hdr, name)
+        if isinstance(v, int):
+            out[name] = str(v)
+        else:
+            out[name] = "0x" + bytes(v).hex()
+    return out
+
+
+def _header_from_json(types, obj: dict, fork: str):
+    cls = types.ExecutionPayloadHeader[fork]
+    kwargs = {}
+    for name, _ in cls.FIELDS:
+        v = obj[name]
+        if v.startswith("0x"):
+            kwargs[name] = bytes.fromhex(v[2:])
+        else:
+            kwargs[name] = int(v)
+    return cls(**kwargs)
+
+
+class BuilderHttpClient:
+    """BN-side builder API client (builder_client crate)."""
+
+    def __init__(self, base_url: str, types, spec, fork: str = "capella",
+                 timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.types = types
+        self.spec = spec
+        self.fork = fork
+        self.timeout = timeout
+
+    def _get(self, path: str):
+        try:
+            with _urlreq.urlopen(self.base_url + path,
+                                 timeout=self.timeout) as r:
+                return json.loads(r.read())
+        except Exception as e:
+            raise BuilderError(f"builder GET {path} failed: {e}")
+
+    def _post(self, path: str, body):
+        req = _urlreq.Request(
+            self.base_url + path, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with _urlreq.urlopen(req, timeout=self.timeout) as r:
+                return json.loads(r.read() or b"{}")
+        except Exception as e:
+            raise BuilderError(f"builder POST {path} failed: {e}")
+
+    def get_header(self, slot: int, parent_hash: bytes, pubkey: bytes):
+        """-> SignedBuilderBid object, signature verified."""
+        out = self._get(
+            f"/eth/v1/builder/header/{slot}/0x{bytes(parent_hash).hex()}"
+            f"/0x{bytes(pubkey).hex()}"
+        )
+        t = self.types
+        data = out["data"]
+        bid = t.BuilderBid[self.fork](
+            header=_header_from_json(t, data["message"]["header"], self.fork),
+            value=int(data["message"]["value"]),
+            pubkey=bytes.fromhex(data["message"]["pubkey"][2:]),
+        )
+        signed = t.SignedBuilderBid[self.fork](
+            message=bid,
+            signature=bytes.fromhex(data["signature"][2:]),
+        )
+        if not verify_builder_bid(t, self.spec, signed, self.fork):
+            raise BuilderError("builder bid signature invalid")
+        return signed
+
+    def register_validators(self, registrations) -> None:
+        self._post("/eth/v1/builder/validators", registrations)
+
+    def submit_blinded_block(self, signed_blinded_json: dict):
+        """-> revealed ExecutionPayload."""
+        out = self._post("/eth/v1/builder/blinded_blocks", signed_blinded_json)
+        return json_to_payload(self.types, out["data"], self.fork)

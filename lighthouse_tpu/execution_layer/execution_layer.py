@@ -25,14 +25,17 @@ class ExecutionLayerError(Exception):
 
 class ExecutionLayer:
     def __init__(self, engine, types=None, fork: str = "capella",
-                 fee_recipient: bytes = b"\x00" * 20):
+                 fee_recipient: bytes = b"\x00" * 20, builder=None):
         """`engine` is anything exposing the engine-API surface: a
         MockExecutionEngine directly, or `ExecutionLayer.http(url, secret)`
-        for a real endpoint."""
+        for a real endpoint. `builder` is an optional BuilderHttpClient (or
+        MockBuilder) enabling blinded production (lib.rs:785 builder
+        branch)."""
         self.engine = engine
         self.types = types
         self.fork = fork
         self.fee_recipient = fee_recipient
+        self.builder = builder
         self.engine_online = True
         self._lock = threading.Lock()
 

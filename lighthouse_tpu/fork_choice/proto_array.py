@@ -296,12 +296,7 @@ class ProtoArrayForkChoice:
                     n.execution_status = ExecutionStatus.VALID
                 j = n.parent
         else:
-            invalid = {idx}
-            for i in range(idx + 1, len(self.nodes)):
-                if self.nodes[i].parent in invalid:
-                    invalid.add(i)
-            for i in invalid:
-                self.nodes[i].execution_status = ExecutionStatus.INVALID
+            self._invalidate_subtree({idx})
 
     def on_invalid_payload(self, head_block_hash: bytes,
                            latest_valid_hash: Optional[bytes] = None,
@@ -335,6 +330,12 @@ class ProtoArrayForkChoice:
                 break  # never invalidate the justified/finalized spine
             invalid.add(j)
             j = n.parent
+        self._invalidate_subtree(invalid)
+
+    def _invalidate_subtree(self, seeds: set) -> None:
+        """Mark `seeds` and every descendant INVALID (nodes are stored in
+        insertion order, so one forward pass closes the set)."""
+        invalid = set(seeds)
         for i in range(min(invalid, default=len(self.nodes)), len(self.nodes)):
             if self.nodes[i].parent in invalid:
                 invalid.add(i)

@@ -295,6 +295,35 @@ class BeaconApiServer:
             return {"version": fork,
                     "data": to_json(t.BeaconBlock[fork], block)}
 
+        m = re.fullmatch(r"/eth/v1/validator/blinded_blocks/(\d+)", path)
+        if m:
+            slot = int(m.group(1))
+            reveal = bytes.fromhex(query["randao_reveal"][0][2:])
+            graffiti = b"\x00" * 32
+            if "graffiti" in query:
+                graffiti = bytes.fromhex(query["graffiti"][0][2:])
+            block, _post = chain.produce_block(slot, reveal, graffiti,
+                                               blinded=True)
+            fork = chain.fork_at(slot)
+            return {"version": fork,
+                    "data": to_json(t.BlindedBeaconBlock[fork], block)}
+
+        if path == "/eth/v1/beacon/blinded_blocks" and method == "POST":
+            return self._publish_blinded_block(body)
+
+        if path == "/eth/v1/validator/register_validator" and method == "POST":
+            # Forward validator registrations to the builder (the BN relays
+            # the VC's SignedValidatorRegistrations). Decoding through the
+            # container both validates the payload and keeps the type real.
+            regs = [from_json(t.SignedValidatorRegistration, r) for r in body]
+            el = chain.execution_layer
+            if el is not None and el.builder is not None and \
+                    hasattr(el.builder, "register_validators"):
+                el.builder.register_validators([
+                    to_json(t.SignedValidatorRegistration, r) for r in regs
+                ])
+            return {}
+
         if path == "/eth/v1/beacon/pool/attestations" and method == "POST":
             return self._submit_attestations(body)
 
@@ -487,6 +516,62 @@ class BeaconApiServer:
         })
         if self.network is not None:
             self.network.publish_block(signed)
+        return {}
+
+    def _publish_blinded_block(self, body) -> Dict[str, Any]:
+        """Un-blind via the builder (submit_blinded_block reveals the
+        payload), reassemble the full signed block, import + publish — the
+        reference's blinded publish path."""
+        chain = self.chain
+        t = chain.types
+        slot = int(body["message"]["slot"])
+        fork = chain.fork_at(slot)
+        el = chain.execution_layer
+        if el is None or el.builder is None:
+            raise ApiError(400, "no builder configured")
+        signed_blinded = from_json(t.SignedBlindedBeaconBlock[fork], body)
+        from lighthouse_tpu.execution_layer.builder import BuilderError
+
+        try:
+            payload = el.builder.submit_blinded_block(body)
+        except BuilderError as e:
+            raise ApiError(400, f"builder refused: {e}")
+
+        bmsg = signed_blinded.message
+        bbody = bmsg.body
+        # Rebuild the full body field-for-field (fork-agnostic: deneb keeps
+        # its blob_kzg_commitments), swapping the header for the payload.
+        kwargs = {}
+        for name, _typ in type(bbody).FIELDS:
+            if name == "execution_payload_header":
+                kwargs["execution_payload"] = payload
+            else:
+                kwargs[name] = getattr(bbody, name)
+        full_body = t.BeaconBlockBody[fork](**kwargs)
+        full = t.SignedBeaconBlock[fork](
+            message=t.BeaconBlock[fork](
+                slot=bmsg.slot,
+                proposer_index=bmsg.proposer_index,
+                parent_root=bmsg.parent_root,
+                state_root=bmsg.state_root,
+                body=full_body,
+            ),
+            signature=signed_blinded.signature,
+        )
+        # Root identity check: the revealed payload must match the header
+        # the proposer signed.
+        if t.BeaconBlock[fork].hash_tree_root(full.message) != \
+                t.BlindedBeaconBlock[fork].hash_tree_root(bmsg):
+            raise ApiError(400, "revealed payload does not match signed header")
+        try:
+            root = chain.process_block(full)
+        except BlockError as e:
+            raise ApiError(400, f"block rejected: {e}")
+        self.events.publish("block", {
+            "slot": str(slot), "block": "0x" + root.hex(),
+        })
+        if self.network is not None:
+            self.network.publish_block(full)
         return {}
 
     def _proposer_duties(self, epoch: int) -> Dict[str, Any]:

@@ -83,8 +83,16 @@ def per_block_processing(
 
     process_block_header(state, types, spec, block)
     if ForkName.ge(fork, ForkName.BELLATRIX):
-        process_withdrawals(state, types, spec, block.body.execution_payload, fork)
-        process_execution_payload(state, types, spec, block.body, fork)
+        if hasattr(block.body, "execution_payload_header"):
+            # Blinded block (builder flow): only the payload header is
+            # known; withdrawals verify against its withdrawals_root.
+            hdr = block.body.execution_payload_header
+            process_withdrawals_blinded(state, types, spec, hdr, fork)
+            process_execution_payload_blinded(state, types, spec, hdr, fork)
+        else:
+            process_withdrawals(state, types, spec,
+                                block.body.execution_payload, fork)
+            process_execution_payload(state, types, spec, block.body, fork)
     process_randao(state, types, spec, block, fork, verify_signatures, get_pubkey)
     process_eth1_data(state, types, spec, block.body)
     process_operations(state, types, spec, block.body, fork, verify_signatures, get_pubkey)
@@ -134,20 +142,22 @@ def process_block_header(state, types, spec, block) -> None:
     proposer = state.validators[block.proposer_index]
     _require(not proposer.slashed, "proposer slashed")
 
-    body_cls = types.BeaconBlockBody[_fork_of_body(types, block.body)]
     state.latest_block_header = types.BeaconBlockHeader(
         slot=block.slot,
         proposer_index=block.proposer_index,
         parent_root=block.parent_root,
         state_root=b"\x00" * 32,  # filled at next slot processing
-        body_root=body_cls.hash_tree_root(block.body),
+        body_root=_body_cls_of(types, block.body).hash_tree_root(block.body),
     )
 
 
-def _fork_of_body(types, body) -> str:
-    for fork, cls in types.BeaconBlockBody.items():
-        if isinstance(body, cls):
-            return fork
+def _body_cls_of(types, body):
+    """Body class for full OR blinded bodies (blinded body roots equal the
+    full body's, so the resulting header is identical either way)."""
+    for registry in (types.BeaconBlockBody, types.BlindedBeaconBlockBody):
+        for cls in registry.values():
+            if isinstance(body, cls):
+                return cls
     raise BlockProcessingError("unknown block body type")
 
 
@@ -630,6 +640,15 @@ def process_execution_payload(state, types, spec, body, fork) -> None:
         "payload timestamp mismatch",
     )
 
+    state.latest_execution_payload_header = payload_to_header(
+        types, spec, payload, fork
+    )
+
+
+def payload_to_header(types, spec, payload, fork):
+    """ExecutionPayload -> ExecutionPayloadHeader (variable fields replaced
+    by their SSZ roots). header.hash_tree_root == payload.hash_tree_root, the
+    property blinded blocks rely on for signing parity."""
     header_cls = {
         ForkName.BELLATRIX: types.ExecutionPayloadHeaderBellatrix,
         ForkName.CAPELLA: types.ExecutionPayloadHeaderCapella,
@@ -658,7 +677,57 @@ def process_execution_payload(state, types, spec, body, fork) -> None:
     if ForkName.ge(fork, ForkName.DENEB):
         fields["blob_gas_used"] = payload.blob_gas_used
         fields["excess_blob_gas"] = payload.excess_blob_gas
-    state.latest_execution_payload_header = header_cls(**fields)
+    return header_cls(**fields)
+
+
+def process_withdrawals_blinded(state, types, spec, header, fork) -> None:
+    """Blinded-body withdrawals: the expected sweep must merkle-match the
+    header's withdrawals_root; state mutations are identical."""
+    if not ForkName.ge(fork, ForkName.CAPELLA):
+        return
+    expected = get_expected_withdrawals(state, types, spec)
+    wlist = ssz.List(types.Withdrawal, spec.preset.MAX_WITHDRAWALS_PER_PAYLOAD)
+    _require(
+        wlist.hash_tree_root(expected) == bytes(header.withdrawals_root),
+        "withdrawals root does not match expected sweep",
+    )
+    _apply_withdrawals(state, spec, expected)
+
+
+def _apply_withdrawals(state, spec, expected) -> None:
+    for w in expected:
+        h.decrease_balance(state, w.validator_index, w.amount)
+    if expected:
+        state.next_withdrawal_index = expected[-1].index + 1
+    if len(expected) == spec.preset.MAX_WITHDRAWALS_PER_PAYLOAD:
+        state.next_withdrawal_validator_index = (
+            expected[-1].validator_index + 1
+        ) % len(state.validators)
+    else:
+        state.next_withdrawal_validator_index = (
+            state.next_withdrawal_validator_index
+            + spec.preset.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP
+        ) % len(state.validators)
+
+
+def process_execution_payload_blinded(state, types, spec, header, fork) -> None:
+    """Header-only payload checks (blinded processing in the reference's
+    per_block_processing over BlindedPayload bodies)."""
+    _require(
+        bytes(header.parent_hash)
+        == bytes(state.latest_execution_payload_header.block_hash),
+        "payload parent hash mismatch",
+    )
+    _require(
+        bytes(header.prev_randao)
+        == h.get_randao_mix(state, spec, h.get_current_epoch(state, spec)),
+        "payload prev_randao mismatch",
+    )
+    _require(
+        header.timestamp == state.genesis_time + state.slot * spec.seconds_per_slot,
+        "payload timestamp mismatch",
+    )
+    state.latest_execution_payload_header = header.copy()
 
 
 def has_eth1_withdrawal_credential(v) -> bool:
@@ -723,16 +792,4 @@ def process_withdrawals(state, types, spec, payload, fork) -> None:
     _require(
         list(payload.withdrawals) == expected, "withdrawals do not match expected"
     )
-    for w in expected:
-        h.decrease_balance(state, w.validator_index, w.amount)
-    if expected:
-        state.next_withdrawal_index = expected[-1].index + 1
-    if len(expected) == spec.preset.MAX_WITHDRAWALS_PER_PAYLOAD:
-        state.next_withdrawal_validator_index = (
-            expected[-1].validator_index + 1
-        ) % len(state.validators)
-    else:
-        state.next_withdrawal_validator_index = (
-            state.next_withdrawal_validator_index
-            + spec.preset.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP
-        ) % len(state.validators)
+    _apply_withdrawals(state, spec, expected)

@@ -408,6 +408,90 @@ def make_types(preset: Preset) -> SimpleNamespace:
         "deneb": BeaconBlockBodyDeneb,
     }
 
+    # -- blinded bodies/blocks (builder flow) --------------------------------
+    # The payload is replaced by its header; because header root == payload
+    # root, a BlindedBeaconBlock signs and hashes identically to the full
+    # block it stands in for (the builder-API property the reference's
+    # blinded production relies on).
+
+    _HEADER_BY_FORK = {
+        "bellatrix": ExecutionPayloadHeaderBellatrix,
+        "capella": ExecutionPayloadHeaderCapella,
+        "deneb": ExecutionPayloadHeaderDeneb,
+    }
+
+    _BLINDED_BODY_BY_FORK = {}
+    for _fork, _Body in _BODY_BY_FORK.items():
+        if _fork not in _HEADER_BY_FORK:
+            continue
+        _fields = [
+            (n, (_HEADER_BY_FORK[_fork] if n == "execution_payload" else t))
+            for n, t in _Body.FIELDS
+        ]
+        _fields = [
+            ("execution_payload_header" if n == "execution_payload" else n, t)
+            for n, t in _fields
+        ]
+        _BLINDED_BODY_BY_FORK[_fork] = _ContainerMeta(
+            f"BlindedBeaconBlockBody_{_fork}", (Container,), {"FIELDS": _fields}
+        )
+
+    _blinded_block_classes = {}
+    _signed_blinded_block_classes = {}
+    for _fork, _BBody in _BLINDED_BODY_BY_FORK.items():
+        _BBlock = _ContainerMeta(
+            f"BlindedBeaconBlock_{_fork}",
+            (Container,),
+            {"FIELDS": [
+                ("slot", uint64),
+                ("proposer_index", uint64),
+                ("parent_root", Bytes32),
+                ("state_root", Bytes32),
+                ("body", _BBody),
+            ]},
+        )
+        _blinded_block_classes[_fork] = _BBlock
+        _signed_blinded_block_classes[_fork] = _ContainerMeta(
+            f"SignedBlindedBeaconBlock_{_fork}",
+            (Container,),
+            {"FIELDS": [("message", _BBlock), ("signature", Bytes96)]},
+        )
+
+    # -- builder API containers (builder_client / mock_builder) --------------
+
+    _builder_bid_classes = {}
+    _signed_builder_bid_classes = {}
+    for _fork, _Hdr in _HEADER_BY_FORK.items():
+        _Bid = _ContainerMeta(
+            f"BuilderBid_{_fork}",
+            (Container,),
+            {"FIELDS": [
+                ("header", _Hdr),
+                ("value", uint256),
+                ("pubkey", Bytes48),
+            ]},
+        )
+        _builder_bid_classes[_fork] = _Bid
+        _signed_builder_bid_classes[_fork] = _ContainerMeta(
+            f"SignedBuilderBid_{_fork}",
+            (Container,),
+            {"FIELDS": [("message", _Bid), ("signature", Bytes96)]},
+        )
+
+    class ValidatorRegistration(Container):
+        FIELDS = [
+            ("fee_recipient", Bytes20),
+            ("gas_limit", uint64),
+            ("timestamp", uint64),
+            ("pubkey", Bytes48),
+        ]
+
+    class SignedValidatorRegistration(Container):
+        FIELDS = [
+            ("message", ValidatorRegistration),
+            ("signature", Bytes96),
+        ]
+
     _block_classes = {}
     _signed_block_classes = {}
     for _fork, _Body in _BODY_BY_FORK.items():
@@ -511,6 +595,12 @@ def make_types(preset: Preset) -> SimpleNamespace:
     ns.BeaconBlock = _block_classes
     ns.SignedBeaconBlock = _signed_block_classes
     ns.BeaconBlockBody = dict(_BODY_BY_FORK)
+    ns.BlindedBeaconBlock = _blinded_block_classes
+    ns.SignedBlindedBeaconBlock = _signed_blinded_block_classes
+    ns.BlindedBeaconBlockBody = dict(_BLINDED_BODY_BY_FORK)
+    ns.ExecutionPayloadHeader = dict(_HEADER_BY_FORK)
+    ns.BuilderBid = _builder_bid_classes
+    ns.SignedBuilderBid = _signed_builder_bid_classes
     ns.BeaconState = dict(_STATE_BY_FORK)
     ns.Transaction = Transaction
     return ns

@@ -48,12 +48,16 @@ class ValidatorClient:
         types,
         spec,
         doppelganger_epochs: int = 0,
+        builder_proposals: bool = False,
     ):
         self.store = store
         self.bn = beacon_nodes
         self.types = types
         self.spec = spec
         self.doppelganger_epochs = doppelganger_epochs
+        # --builder-proposals: produce blinded blocks through the external
+        # builder and publish via the blinded endpoint.
+        self.builder_proposals = builder_proposals
         self._started_epoch: Optional[int] = None
         self.attester_duties: Dict[int, List[dict]] = {}   # epoch -> duties
         self.proposer_duties: Dict[int, List[dict]] = {}
@@ -135,6 +139,33 @@ class ValidatorClient:
                 continue
             fork_info = self._ensure_fork_info()
             reveal = self.store.sign_randao(pk, epoch, fork_info)
+            if self.builder_proposals:
+                out = self.bn.call(
+                    lambda c: c.get_blinded_block_proposal(slot, reveal)
+                )
+                fork = out["version"]
+                block = from_json(
+                    self.types.BlindedBeaconBlock[fork], out["data"]
+                )
+                try:
+                    sig = self.store.sign_block(pk, block, fork, fork_info,
+                                                blinded=True)
+                except NotSafe:
+                    return 0
+                signed = self.types.SignedBlindedBeaconBlock[fork](
+                    message=block, signature=sig
+                )
+                try:
+                    self.bn.call(lambda c: c.publish_blinded_block(
+                        to_json(self.types.SignedBlindedBeaconBlock[fork],
+                                signed)
+                    ))
+                except Exception:
+                    # Builder failed to reveal (or BN rejected): the duty is
+                    # missed, the daemon carries on (block_service logs and
+                    # continues in the reference).
+                    return 0
+                return 1
             out = self.bn.call(lambda c: c.get_block_proposal(slot, reveal))
             fork = out["version"]
             block = from_json(self.types.BeaconBlock[fork], out["data"])

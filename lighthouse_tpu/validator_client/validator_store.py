@@ -93,12 +93,15 @@ class ValidatorStore:
             fork_info["epoch"], fork_info["genesis_validators_root"],
         )
 
-    def sign_block(self, pubkey: bytes, block, fork: str, fork_info) -> bytes:
+    def sign_block(self, pubkey: bytes, block, fork: str, fork_info,
+                   blinded: bool = False) -> bytes:
+        """Blinded blocks sign under the same domain; their root equals the
+        full block's, so slashing protection sees one proposal either way."""
         epoch = self.spec.epoch_at_slot(block.slot)
         domain = self._domain(fork_info, DOMAIN_BEACON_PROPOSER, epoch)
-        root = compute_signing_root(
-            block, self.types.BeaconBlock[fork], domain
-        )
+        block_cls = (self.types.BlindedBeaconBlock[fork] if blinded
+                     else self.types.BeaconBlock[fork])
+        root = compute_signing_root(block, block_cls, domain)
         self.slashing_db.check_and_insert_block_proposal(
             pubkey, block.slot, root
         )

@@ -1,0 +1,235 @@
+"""External builder (MEV) flow: bids, blinded production, un-blinding via
+the builder, and builder-fault handling (reference: builder_client,
+execution_layer/src/test_utils/mock_builder.rs, blinded branch of
+lib.rs:785)."""
+
+import pytest
+
+from lighthouse_tpu.execution_layer import ExecutionLayer, MockExecutionEngine
+from lighthouse_tpu.execution_layer.builder import (
+    BuilderError,
+    BuilderHttpClient,
+    MockBuilder,
+    MockBuilderServer,
+    verify_builder_bid,
+)
+from lighthouse_tpu.http_api import BeaconApiServer
+from lighthouse_tpu.testing.harness import BeaconChainHarness
+from lighthouse_tpu.types.spec import compute_signing_root, DOMAIN_BEACON_PROPOSER
+
+
+def _setup(http_builder: bool = False):
+    harness = BeaconChainHarness(n_validators=16, bls_backend="fake")
+    chain = harness.chain
+    state = chain.head.state
+    engine = MockExecutionEngine(
+        harness.types,
+        terminal_block_hash=bytes(
+            state.latest_execution_payload_header.block_hash
+        ),
+    )
+    el = ExecutionLayer(engine, types=harness.types)
+    chain.execution_layer = el
+    builder = MockBuilder(el, harness.types, harness.spec)
+    builder.chain = chain
+    server = client = None
+    if http_builder:
+        server = MockBuilderServer(builder).start()
+        client = BuilderHttpClient(server.url, harness.types, harness.spec)
+        el.builder = client
+    else:
+        el.builder = builder
+    return harness, builder, server
+
+
+def _sign_blinded(harness, state, blinded_block, fork):
+    t, spec = harness.types, harness.spec
+    domain = harness._domain(
+        state, DOMAIN_BEACON_PROPOSER, spec.epoch_at_slot(blinded_block.slot)
+    )
+    root = compute_signing_root(
+        blinded_block, t.BlindedBeaconBlock[fork], domain
+    )
+    sig = harness.keys[blinded_block.proposer_index].sign(root)
+    return t.SignedBlindedBeaconBlock[fork](
+        message=blinded_block, signature=sig.to_bytes()
+    )
+
+
+def test_bid_signature_roundtrip():
+    harness, builder, _ = _setup()
+    t, spec = harness.types, harness.spec
+    signed_bid = builder.get_header(
+        1, bytes(harness.chain.head.state
+                 .latest_execution_payload_header.block_hash),
+        b"\x11" * 48,
+    )
+    assert verify_builder_bid(t, spec, signed_bid, "capella")
+    # Tampered value => signature fails.
+    signed_bid.message.value += 1
+    assert not verify_builder_bid(t, spec, signed_bid, "capella")
+
+
+def test_blinded_production_and_unblinded_import():
+    """produce(blinded) -> sign -> POST blinded_blocks -> builder reveals ->
+    full block imported and becomes head."""
+    harness, builder, _ = _setup()
+    chain = harness.chain
+    api = BeaconApiServer(chain).start()
+    try:
+        from lighthouse_tpu.http_api.json_codec import to_json
+
+        harness.advance_slot()
+        slot = harness.current_slot
+        state = chain.head.state
+        fork = chain.fork_at(slot)
+        proposer_state = chain.head_state_clone_at(slot)
+        from lighthouse_tpu.state_transition import helpers as h
+        import lighthouse_tpu.state_transition.slot_processing as sp
+
+        ps = proposer_state.copy()
+        ps = sp.process_slots(ps, chain.types, chain.spec, slot)
+        reveal = harness.randao_reveal(
+            state, chain.spec.epoch_at_slot(slot),
+            h.get_beacon_proposer_index(ps, chain.spec),
+        )
+        blinded, _post = chain.produce_block(slot, reveal, blinded=True)
+        assert hasattr(blinded.body, "execution_payload_header")
+
+        signed = _sign_blinded(harness, state, blinded, fork)
+        body_json = to_json(
+            chain.types.SignedBlindedBeaconBlock[fork], signed
+        )
+        out = api.dispatch(
+            "POST", "/eth/v1/beacon/blinded_blocks", {}, body_json
+        )
+        assert out == {}
+        root = chain.types.BlindedBeaconBlock[fork].hash_tree_root(blinded)
+        assert chain.head.block_root == root
+        # The imported block is FULL (payload revealed and stored).
+        stored = chain.store.get_block(root)
+        assert hasattr(stored.message.body, "execution_payload")
+    finally:
+        api.stop()
+
+
+def test_blinded_flow_over_http_builder_api():
+    """Same flow with the builder behind its REST API (real process
+    boundary): bid via GET header, reveal via POST blinded_blocks."""
+    harness, builder, server = _setup(http_builder=True)
+    chain = harness.chain
+    api = BeaconApiServer(chain).start()
+    try:
+        from lighthouse_tpu.http_api.json_codec import to_json
+        from lighthouse_tpu.state_transition import helpers as h
+        import lighthouse_tpu.state_transition.slot_processing as sp
+
+        harness.advance_slot()
+        slot = harness.current_slot
+        state = chain.head.state
+        fork = chain.fork_at(slot)
+        ps = chain.head_state_clone_at(slot).copy()
+        ps = sp.process_slots(ps, chain.types, chain.spec, slot)
+        reveal = harness.randao_reveal(
+            state, chain.spec.epoch_at_slot(slot),
+            h.get_beacon_proposer_index(ps, chain.spec),
+        )
+        blinded, _ = chain.produce_block(slot, reveal, blinded=True)
+        signed = _sign_blinded(harness, state, blinded, fork)
+        out = api.dispatch(
+            "POST", "/eth/v1/beacon/blinded_blocks", {},
+            to_json(chain.types.SignedBlindedBeaconBlock[fork], signed),
+        )
+        assert out == {}
+        assert chain.head.block_root == \
+            chain.types.BlindedBeaconBlock[fork].hash_tree_root(blinded)
+    finally:
+        api.stop()
+        server.stop()
+
+
+def test_vc_builder_proposals_end_to_end():
+    """A --builder-proposals validator client proposes a blinded block over
+    real HTTP: duty poll -> blinded production -> sign -> blinded publish ->
+    un-blinded import (reference VC block_service builder flow)."""
+    from lighthouse_tpu.validator_client import (
+        BeaconNodeFallback,
+        ValidatorClient,
+        ValidatorStore,
+    )
+    from lighthouse_tpu.common.eth2_client import BeaconNodeHttpClient
+
+    harness, builder, _ = _setup()
+    chain = harness.chain
+    from lighthouse_tpu.op_pool import OperationPool
+
+    chain.op_pool = OperationPool(harness.types, harness.spec)
+    api = BeaconApiServer(chain).start()
+    try:
+        store = ValidatorStore(harness.types, harness.spec)
+        for i, sk in enumerate(harness.keys):
+            store.add_validator(sk, index=i)
+        vc = ValidatorClient(
+            store, BeaconNodeFallback([BeaconNodeHttpClient(api.url)]),
+            harness.types, harness.spec, builder_proposals=True,
+        )
+        blocks = 0
+        for _ in range(3):
+            harness.advance_slot()
+            slot = harness.current_slot
+            stats = vc.run_slot(slot)
+            blocks += stats["blocks"]
+        assert blocks == 3
+        assert chain.head.state.slot == harness.current_slot
+        # Heads are full blocks (payloads revealed by the builder).
+        assert hasattr(chain.store.get_block(chain.head.block_root)
+                       .message.body, "execution_payload")
+    finally:
+        api.stop()
+
+
+def test_corrupt_builder_header_rejected():
+    """A bid whose header does not chain onto the parent fails blinded
+    production (state-transition parent-hash check)."""
+    harness, builder, _ = _setup()
+    chain = harness.chain
+    builder.corrupt_parent_hash = True
+    harness.advance_slot()
+    with pytest.raises(Exception):
+        chain.produce_block(harness.current_slot, b"\x00" * 96, blinded=True)
+
+
+def test_builder_refuses_reveal():
+    """Builder withholding the payload: the blinded publish fails without
+    poisoning the chain (no partial import)."""
+    harness, builder, _ = _setup()
+    chain = harness.chain
+    api = BeaconApiServer(chain).start()
+    try:
+        from lighthouse_tpu.http_api.json_codec import to_json
+        from lighthouse_tpu.http_api.server import ApiError
+        from lighthouse_tpu.state_transition import helpers as h
+        import lighthouse_tpu.state_transition.slot_processing as sp
+
+        harness.advance_slot()
+        slot = harness.current_slot
+        state = chain.head.state
+        fork = chain.fork_at(slot)
+        ps = chain.head_state_clone_at(slot).copy()
+        ps = sp.process_slots(ps, chain.types, chain.spec, slot)
+        reveal = harness.randao_reveal(
+            state, chain.spec.epoch_at_slot(slot),
+            h.get_beacon_proposer_index(ps, chain.spec),
+        )
+        blinded, _ = chain.produce_block(slot, reveal, blinded=True)
+        signed = _sign_blinded(harness, state, blinded, fork)
+        builder.refuse_reveal = True
+        head_before = chain.head.block_root
+        with pytest.raises(ApiError):
+            api.dispatch(
+                "POST", "/eth/v1/beacon/blinded_blocks", {},
+                to_json(chain.types.SignedBlindedBeaconBlock[fork], signed),
+            )
+        assert chain.head.block_root == head_before
+    finally:
+        api.stop()
