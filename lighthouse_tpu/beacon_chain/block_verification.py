@@ -88,7 +88,8 @@ def gossip_verify_block(chain, signed_block) -> GossipVerifiedBlock:
             chain.spec.epoch_at_slot(chain.head.state.slot) >= epoch:
         sig_state = chain.head.state
     else:
-        sig_state = chain.state_for_block_import(parent_root)
+        sig_state = chain.state_for_block_import(parent_root,
+                                                 max_slot=block.slot)
         if sig_state is None:
             raise BlockError("ParentUnknown", parent_root.hex())
         target_start = chain.spec.start_slot_of_epoch(epoch)
@@ -123,7 +124,8 @@ def signature_verify_block(
     block = signed_block.message
     parent_root = bytes(block.parent_root)
 
-    pre_state = chain.state_for_block_import(parent_root)
+    pre_state = chain.state_for_block_import(parent_root,
+                                             max_slot=block.slot)
     if pre_state is None:
         raise BlockError("ParentUnknown", parent_root.hex())
     fork = chain.fork_at(block.slot)

@@ -165,6 +165,14 @@ class SnapshotCache:
             return None
         return hit[0].copy()
 
+    def update_state(self, block_root: bytes, state) -> None:
+        """Replace an entry's state (the state-advance pre-computation),
+        keeping its block."""
+        with self._lock:
+            prev = self._map.get(block_root)
+            self._map[block_root] = (state, prev[1] if prev else None)
+            self._map.move_to_end(block_root)
+
     def contains(self, block_root: bytes) -> bool:
         with self._lock:
             return block_root in self._map

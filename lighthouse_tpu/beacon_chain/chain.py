@@ -208,11 +208,15 @@ class BeaconChain:
     def pubkey_getter(self, validator_index: int):
         return self.pubkey_cache.get(validator_index)
 
-    def state_for_block_import(self, parent_block_root: bytes):
+    def state_for_block_import(self, parent_block_root: bytes,
+                               max_slot: Optional[int] = None):
         """Pre-state for a child of `parent_block_root` (clone). Snapshot
-        cache first, store summary replay second."""
+        cache first, store summary replay second. `max_slot` guards against
+        the state-advance pre-computation: a cached state advanced PAST the
+        child's slot cannot be rewound, so a late block falls back to the
+        store's exact post-state."""
         state = self.snapshot_cache.get_state_clone(parent_block_root)
-        if state is not None:
+        if state is not None and (max_slot is None or state.slot <= max_slot):
             return state
         state_root = self._state_root_by_block.get(parent_block_root)
         if state_root is None:
@@ -438,11 +442,29 @@ class BeaconChain:
         from lighthouse_tpu.crypto.bls import api as bls
         from lighthouse_tpu.state_transition import block_processing as bp
 
+        # Builder bid fetch is a network round-trip: do it BEFORE taking the
+        # chain lock (same rule as fcU — a slow builder must not stall
+        # imports). The parent is re-checked under the lock.
+        prefetched_bid = None
+        if blinded:
+            if self.execution_layer is None or \
+                    self.execution_layer.builder is None:
+                raise RuntimeError("blinded production requires a builder")
+            ps = self.head_state_clone_at(slot)
+            proposer_i = h.get_beacon_proposer_index(ps, self.spec, slot=slot)
+            pk = self.pubkey_cache.get(proposer_i)
+            prefetched_bid = self.execution_layer.builder.get_header(
+                slot,
+                bytes(self.head.state.latest_execution_payload_header
+                      .block_hash),
+                pk.to_bytes() if pk is not None else b"\x00" * 48,
+            )
+
         with self._lock:
             t, spec = self.types, self.spec
             fork = self.fork_at(slot)
             parent_root = self.head.block_root
-            state = self.state_for_block_import(parent_root)
+            state = self.state_for_block_import(parent_root, max_slot=slot)
             state = sp.process_slots(state, t, spec, slot)
             epoch = spec.epoch_at_slot(slot)
 
@@ -480,17 +502,13 @@ class BeaconChain:
 
             payload_header = None
             if blinded:
-                if self.execution_layer is None or \
-                        self.execution_layer.builder is None:
-                    raise RuntimeError("blinded production requires a builder")
-                proposer_i = h.get_beacon_proposer_index(state, spec)
-                pk = self.pubkey_cache.get(proposer_i)
-                signed_bid = self.execution_layer.builder.get_header(
-                    slot,
-                    bytes(state.latest_execution_payload_header.block_hash),
-                    pk.to_bytes() if pk is not None else b"\x00" * 48,
-                )
-                payload_header = signed_bid.message.header
+                payload_header = prefetched_bid.message.header
+                if bytes(payload_header.parent_hash) != bytes(
+                    state.latest_execution_payload_header.block_hash
+                ):
+                    raise RuntimeError(
+                        "builder bid raced a head change; retry production"
+                    )
                 payload = None
             elif self.execution_layer is not None:
                 payload = self.execution_layer.get_payload(
@@ -673,6 +691,22 @@ class BeaconChain:
     @property
     def head_is_optimistic(self) -> bool:
         return self.fork_choice.proto.is_optimistic(self.head.block_root)
+
+    def advance_head_state_to(self, slot: int) -> bool:
+        """state_advance_timer.rs:98: pre-compute the head state advanced to
+        `slot` (usually next slot, 3/4 through the current one) into the
+        snapshot cache, so the next block's import and next-slot attestation
+        production skip their process_slots. Returns True when work ran."""
+        with self._lock:
+            root = self.head.block_root
+            state = self.snapshot_cache.get_state_clone(root)
+            if state is None:
+                state = self.head.state.copy()
+            if state.slot >= slot:
+                return False
+            state = sp.process_slots(state, self.types, self.spec, slot)
+            self.snapshot_cache.update_state(root, state)
+            return True
 
     # ----------------------------------------------------------------- head
 

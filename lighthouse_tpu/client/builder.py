@@ -94,18 +94,30 @@ class Client:
 
     def _slot_timer(self) -> None:
         """Per-slot tick (timer/): recompute head at the slot boundary,
-        advance pool pruning; state_advance_timer's pre-computation is
-        covered by the snapshot cache."""
+        prune pools; 3/4 through each slot the head state pre-advances to
+        the next slot (state_advance_timer.rs:98)."""
         import time as _time
 
         clock = self.chain.slot_clock
         last = clock.now_or_genesis()
+        advanced_for = -1
         while self._running:
             _time.sleep(min(0.05, clock.duration_to_next_slot()))
             now = clock.now_or_genesis()
             if now != last:
                 last = now
                 self.run_slot_tick(now)
+            if now != advanced_for and \
+                    clock.seconds_into_slot() * 4 >= 3 * clock.seconds_per_slot:
+                advanced_for = now
+                self.run_state_advance(now)
+
+    def run_state_advance(self, slot: int) -> None:
+        """Deterministic entry for the 3/4-slot pre-computation."""
+        try:
+            self.chain.advance_head_state_to(slot + 1)
+        except Exception:
+            pass  # best-effort: the import path recomputes if absent
 
     def run_slot_tick(self, slot: int) -> None:
         self.chain.recompute_head()

@@ -98,10 +98,33 @@ _SPLIT_KEY = b"split"
 _ANCHOR_KEY = b"anchor"
 _GENESIS_BLOCK_ROOT_KEY = b"genesis_block_root"
 _HEAD_KEY = b"head"
+_SCHEMA_KEY = b"schema"
+
+# On-disk schema version (beacon_chain/src/schema_change/ analog). Bump when
+# the layout changes and register an upgrade step in _MIGRATIONS.
+CURRENT_SCHEMA_VERSION = 2
 
 
 def _slot_key(slot: int) -> bytes:
     return struct.pack(">Q", slot)  # big-endian so byte order == numeric order
+
+
+def _migrate_v1_to_v2(db: "HotColdDB") -> None:
+    """v2 added the persisted head pointer (`head` meta key). Backfill it
+    from the highest-slot hot state summary so pre-v2 datadirs resume at
+    their latest stored state instead of re-deriving genesis."""
+    if db.hot.get(DBColumn.BeaconMeta, _HEAD_KEY) is not None:
+        return
+    best = None  # (slot, state_root, latest_block_root)
+    for state_root, raw in db.hot.iter_column_from(DBColumn.BeaconStateSummary):
+        s = HotStateSummary.from_bytes(raw)
+        if best is None or s.slot > best[0]:
+            best = (s.slot, state_root, s.latest_block_root)
+    if best is not None:
+        db.put_head_info(best[2], best[1])
+
+
+_MIGRATIONS = {2: _migrate_v1_to_v2}
 
 
 class HotColdDB:
@@ -123,6 +146,37 @@ class HotColdDB:
         self.config = config or StoreConfig()
         raw = self.hot.get(DBColumn.BeaconMeta, _SPLIT_KEY)
         self.split = Split.from_bytes(raw) if raw else Split()
+        self._apply_schema_migrations()
+
+    # -- schema migrations (schema_change/ analog) --------------------------
+
+    def get_schema_version(self) -> int:
+        raw = self.hot.get(DBColumn.BeaconMeta, _SCHEMA_KEY)
+        return struct.unpack("<Q", raw)[0] if raw else 0
+
+    def _put_schema_version(self, v: int) -> None:
+        self.hot.put(DBColumn.BeaconMeta, _SCHEMA_KEY, struct.pack("<Q", v),
+                     sync=True)
+
+    def _apply_schema_migrations(self) -> None:
+        """Fresh stores start at CURRENT; populated stores without a version
+        are v1 (pre-versioning) and upgrade step by step — the reference
+        migrates on open the same way (migrate_schema in schema_change/)."""
+        v = self.get_schema_version()
+        if v == 0:
+            populated = self.hot.get(
+                DBColumn.BeaconMeta, _GENESIS_BLOCK_ROOT_KEY
+            ) is not None
+            v = 1 if populated else CURRENT_SCHEMA_VERSION
+        if v > CURRENT_SCHEMA_VERSION:
+            raise StoreError(
+                f"store schema v{v} is newer than this build "
+                f"(v{CURRENT_SCHEMA_VERSION}): refusing to downgrade"
+            )
+        while v < CURRENT_SCHEMA_VERSION:
+            _MIGRATIONS[v + 1](self)
+            v += 1
+        self._put_schema_version(v)
 
     @classmethod
     def open(cls, path: str, types, spec, config: Optional[StoreConfig] = None):

@@ -110,7 +110,7 @@ class BeaconChainHarness:
         slot = slot if slot is not None else self.current_slot
         fork = chain.fork_at(slot)
 
-        state = chain.state_for_block_import(parent_root)
+        state = chain.state_for_block_import(parent_root, max_slot=slot)
         if state is None:
             raise ValueError("unknown parent")
         state = sp.process_slots(state, types, spec, slot)

@@ -3,13 +3,25 @@
 from .client import BeaconNodeFallback, ValidatorClient
 from .slashing_protection import NotSafe, SlashingDatabase, SlashingProtectionError
 from .validator_store import LocalKeystoreSigner, ValidatorStore
+from .web3signer import (
+    MockWeb3Signer,
+    Web3SignerClient,
+    Web3SignerError,
+    Web3SignerValidator,
+    attach_web3signer,
+)
 
 __all__ = [
     "BeaconNodeFallback",
     "LocalKeystoreSigner",
+    "MockWeb3Signer",
     "NotSafe",
     "SlashingDatabase",
     "SlashingProtectionError",
     "ValidatorClient",
     "ValidatorStore",
+    "Web3SignerClient",
+    "Web3SignerError",
+    "Web3SignerValidator",
+    "attach_web3signer",
 ]

@@ -97,6 +97,8 @@ class KeymanagerApi:
             return self._import_keystores(body)
         if path == "/eth/v1/keystores" and method == "DELETE":
             return self._delete_keystores(body)
+        if path == "/lighthouse/validators/export" and method == "POST":
+            return self._export_validators(body)
         if path.startswith("/eth/v1/validator/") and path.endswith("/feerecipient"):
             pubkey = path.split("/")[4]
             if method == "GET":
@@ -138,6 +140,40 @@ class KeymanagerApi:
                 else body["slashing_protection"]
             )
         return {"data": statuses}
+
+    def _export_validators(self, body) -> dict:
+        """Lighthouse-specific export used by `validator-manager move`:
+        re-encrypt the requested LOCAL keys under the supplied password and
+        return them with the slashing history. Remote (web3signer) keys
+        cannot move and report as such."""
+        password = body["password"]
+        statuses, keystores = [], []
+        for pk_hex in body.get("pubkeys", []):
+            pk = bytes.fromhex(pk_hex[2:] if pk_hex.startswith("0x") else pk_hex)
+            sk = self.store.local_secret_key(pk)
+            if sk is None:
+                statuses.append({"status": "error",
+                                 "message": "not a local key"})
+                keystores.append(None)
+                continue
+            keystores.append(ks.encrypt_keystore(
+                sk.to_bytes(), password, pk
+            ))
+            statuses.append({"status": "exported"})
+        interchange = self.store.slashing_db.export_interchange(
+            self.genesis_validators_root
+        )
+        # Only the moving keys' history travels — seeding the destination
+        # with unrelated validators' records would collide with their own
+        # later moves.
+        wanted = {pk.lower() if pk.startswith("0x") else "0x" + pk.lower()
+                  for pk in body.get("pubkeys", [])}
+        interchange["data"] = [
+            rec for rec in interchange.get("data", [])
+            if rec.get("pubkey", "").lower() in wanted
+        ]
+        return {"data": statuses, "keystores": keystores,
+                "slashing_protection": json.dumps(interchange)}
 
     def _delete_keystores(self, body) -> dict:
         statuses = []

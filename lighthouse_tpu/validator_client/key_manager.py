@@ -57,6 +57,73 @@ def create_validators(
     return out
 
 
+class KeymanagerClient:
+    """HTTP client for a VC's keymanager API (validator_manager talks to
+    VCs only through this boundary)."""
+
+    def __init__(self, base_url: str, token: str, timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self.timeout = timeout
+
+    def _call(self, method: str, path: str, body=None):
+        import urllib.request
+
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.base_url + path, data=data, method=method,
+            headers={
+                "Authorization": f"Bearer {self.token}",
+                "Content-Type": "application/json",
+            },
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.loads(r.read() or b"{}")
+
+    def list_keystores(self):
+        return self._call("GET", "/eth/v1/keystores")["data"]
+
+    def import_keystores(self, keystores, passwords, slashing_protection=None):
+        body = {"keystores": keystores, "passwords": passwords}
+        if slashing_protection is not None:
+            body["slashing_protection"] = slashing_protection
+        return self._call("POST", "/eth/v1/keystores", body)
+
+    def delete_keystores(self, pubkeys):
+        return self._call("DELETE", "/eth/v1/keystores",
+                          {"pubkeys": pubkeys})
+
+    def export_validators(self, pubkeys, password):
+        return self._call("POST", "/lighthouse/validators/export",
+                          {"pubkeys": pubkeys, "password": password})
+
+
+def move_validators(src: KeymanagerClient, dest: KeymanagerClient,
+                    pubkeys: List[str], password: str) -> int:
+    """`validator-manager move` (validator_manager/src/move_validators):
+    export keystores + slashing history from the source VC, DELETE them
+    from the source, then import into the destination. Delete-before-import
+    means a mid-move failure leaves the keys active in zero places — an
+    availability problem the operator can retry (the keystores are in
+    hand) — never in two places signing against diverging slashing DBs,
+    which is slashable."""
+    out = src.export_validators(pubkeys, password)
+    moved_keys = [
+        (pk, keystore)
+        for pk, keystore, st in zip(pubkeys, out["keystores"], out["data"])
+        if st["status"] == "exported"
+    ]
+    if not moved_keys:
+        return 0
+    src.delete_keystores([pk for pk, _ in moved_keys])
+    dest_out = dest.import_keystores(
+        [k for _, k in moved_keys],
+        [password] * len(moved_keys),
+        slashing_protection=out["slashing_protection"],
+    )
+    return sum(1 for st in dest_out["data"] if st["status"] == "imported")
+
+
 def import_validators(validators_dir: str, password: str, store) -> int:
     """Decrypt every keystore in the directory layout into the
     ValidatorStore (account_manager validator import)."""

@@ -38,6 +38,14 @@ class LocalKeystoreSigner:
         return self.sk.sign(signing_root).to_bytes()
 
 
+def _invoke_signer(signer, signing_root: bytes, type_: str) -> bytes:
+    """Remote methods that advertise `accepts_type` (web3signer) get the
+    per-duty message type; plain callables just get the root."""
+    if getattr(signer, "accepts_type", False):
+        return signer(signing_root, type_)
+    return signer(signing_root)
+
+
 class ValidatorStore:
     def __init__(self, types, spec, slashing_db: Optional[SlashingDatabase] = None):
         self.types = types
@@ -78,6 +86,14 @@ class ValidatorStore:
         self._indices.pop(pubkey, None)
         return True
 
+    def local_secret_key(self, pubkey: bytes) -> Optional[bls.SecretKey]:
+        """Secret key of a LOCAL validator (None for remote signers) — the
+        export seam `validator-manager move` needs."""
+        signer = self._signers.get(pubkey)
+        if isinstance(signer, LocalKeystoreSigner):
+            return signer.sk
+        return None
+
     def set_index(self, pubkey: bytes, index: int) -> None:
         self._indices[pubkey] = index
 
@@ -105,7 +121,7 @@ class ValidatorStore:
         self.slashing_db.check_and_insert_block_proposal(
             pubkey, block.slot, root
         )
-        return self._signers[pubkey](root)
+        return _invoke_signer(self._signers[pubkey], root, "BLOCK_V2")
 
     def sign_attestation(self, pubkey: bytes, data, fork_info) -> bytes:
         domain = self._domain(
@@ -115,19 +131,19 @@ class ValidatorStore:
         self.slashing_db.check_and_insert_attestation(
             pubkey, data.source.epoch, data.target.epoch, root
         )
-        return self._signers[pubkey](root)
+        return _invoke_signer(self._signers[pubkey], root, "ATTESTATION")
 
     def sign_randao(self, pubkey: bytes, epoch: int, fork_info) -> bytes:
         domain = self._domain(fork_info, DOMAIN_RANDAO, epoch)
         root = compute_signing_root(epoch, ssz.uint64, domain)
-        return self._signers[pubkey](root)
+        return _invoke_signer(self._signers[pubkey], root, "RANDAO_REVEAL")
 
     def sign_selection_proof(self, pubkey: bytes, slot: int, fork_info) -> bytes:
         domain = self._domain(
             fork_info, DOMAIN_SELECTION_PROOF, self.spec.epoch_at_slot(slot)
         )
         root = compute_signing_root(slot, ssz.uint64, domain)
-        return self._signers[pubkey](root)
+        return _invoke_signer(self._signers[pubkey], root, "AGGREGATION_SLOT")
 
     def sign_aggregate_and_proof(self, pubkey: bytes, msg, fork_info) -> bytes:
         slot = msg.aggregate.data.slot
@@ -137,7 +153,7 @@ class ValidatorStore:
         root = compute_signing_root(
             msg, self.types.AggregateAndProof, domain
         )
-        return self._signers[pubkey](root)
+        return _invoke_signer(self._signers[pubkey], root, "AGGREGATE_AND_PROOF")
 
     def sign_sync_committee_message(self, pubkey: bytes, slot: int,
                                     block_root: bytes, fork_info) -> bytes:
@@ -145,7 +161,7 @@ class ValidatorStore:
             fork_info, DOMAIN_SYNC_COMMITTEE, self.spec.epoch_at_slot(slot)
         )
         root = compute_signing_root(block_root, ssz.Bytes32, domain)
-        return self._signers[pubkey](root)
+        return _invoke_signer(self._signers[pubkey], root, "SYNC_COMMITTEE_MESSAGE")
 
     def sign_sync_selection_proof(self, pubkey: bytes, slot: int,
                                   subcommittee_index: int, fork_info) -> bytes:
@@ -159,7 +175,7 @@ class ValidatorStore:
         root = compute_signing_root(
             data, self.types.SyncAggregatorSelectionData, domain
         )
-        return self._signers[pubkey](root)
+        return _invoke_signer(self._signers[pubkey], root, "SYNC_COMMITTEE_SELECTION_PROOF")
 
     def sign_contribution_and_proof(self, pubkey: bytes, msg, fork_info) -> bytes:
         slot = msg.contribution.slot
@@ -168,4 +184,4 @@ class ValidatorStore:
             self.spec.epoch_at_slot(slot),
         )
         root = compute_signing_root(msg, self.types.ContributionAndProof, domain)
-        return self._signers[pubkey](root)
+        return _invoke_signer(self._signers[pubkey], root, "SYNC_COMMITTEE_CONTRIBUTION_AND_PROOF")

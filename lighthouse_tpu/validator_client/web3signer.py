@@ -1,0 +1,188 @@
+"""Web3Signer remote signing (SigningMethod::Web3Signer).
+
+Reference counterparts: `validator_client/src/signing_method.rs:80-91` (the
+remote variant holds an HTTP client + the validator's public key) and
+`testing/web3signer_tests` (parity of local vs remote signatures against a
+real web3signer process; here the same tests run against MockWeb3Signer, an
+in-process server speaking the same REST surface).
+
+Surface implemented (the consensus subset of web3signer's API):
+  GET  /upcheck                      -> 200 "OK"
+  GET  /api/v1/eth2/publicKeys       -> ["0x..", ...]
+  POST /api/v1/eth2/sign/{pubkey}    {"type": ..., "signingRoot": "0x.."}
+                                     -> {"signature": "0x.."}
+The BN-side slashing protection still runs in THIS process (the store checks
+before calling any signer); web3signer's own slashing DB is additive in the
+reference and out of scope for the mock.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Sequence
+from urllib import request as _urlreq
+
+from lighthouse_tpu.crypto.bls import api as bls
+
+
+class Web3SignerError(Exception):
+    pass
+
+
+class Web3SignerClient:
+    """Typed client for a web3signer endpoint."""
+
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def upcheck(self) -> bool:
+        try:
+            with _urlreq.urlopen(self.base_url + "/upcheck",
+                                 timeout=self.timeout) as r:
+                return r.status == 200
+        except Exception:
+            return False
+
+    def public_keys(self) -> List[bytes]:
+        try:
+            with _urlreq.urlopen(self.base_url + "/api/v1/eth2/publicKeys",
+                                 timeout=self.timeout) as r:
+                return [bytes.fromhex(k[2:]) for k in json.loads(r.read())]
+        except Exception as e:
+            raise Web3SignerError(f"publicKeys failed: {e}")
+
+    def sign(self, pubkey: bytes, signing_root: bytes,
+             type_: str = "BEACON_BLOCK") -> bytes:
+        body = json.dumps({
+            "type": type_,
+            "signingRoot": "0x" + signing_root.hex(),
+        }).encode()
+        req = _urlreq.Request(
+            f"{self.base_url}/api/v1/eth2/sign/0x{bytes(pubkey).hex()}",
+            data=body, headers={"Content-Type": "application/json"},
+        )
+        try:
+            with _urlreq.urlopen(req, timeout=self.timeout) as r:
+                out = json.loads(r.read())
+        except Exception as e:
+            raise Web3SignerError(f"sign failed: {e}")
+        return bytes.fromhex(out["signature"][2:])
+
+
+WEB3SIGNER_TYPES = frozenset({
+    "BLOCK_V2", "ATTESTATION", "RANDAO_REVEAL", "AGGREGATION_SLOT",
+    "AGGREGATE_AND_PROOF", "SYNC_COMMITTEE_MESSAGE",
+    "SYNC_COMMITTEE_SELECTION_PROOF",
+    "SYNC_COMMITTEE_CONTRIBUTION_AND_PROOF", "VOLUNTARY_EXIT",
+    "VALIDATOR_REGISTRATION", "DEPOSIT",
+})
+
+
+class Web3SignerValidator:
+    """SigningMethod::Web3Signer — the callable the ValidatorStore holds.
+    Slashing protection already ran by the time this is invoked. Advertises
+    `accepts_type` so the store labels each request with its duty type (a
+    real web3signer applies per-type validation)."""
+
+    accepts_type = True
+
+    def __init__(self, client: Web3SignerClient, pubkey: bytes):
+        self.client = client
+        self.pubkey = bytes(pubkey)
+
+    def __call__(self, signing_root: bytes,
+                 type_: str = "BLOCK_V2") -> bytes:
+        return self.client.sign(self.pubkey, signing_root, type_=type_)
+
+
+def attach_web3signer(store, client: Web3SignerClient,
+                      indices: Dict[bytes, int] | None = None) -> List[bytes]:
+    """Discover the signer's keys and register them as remote validators
+    (init_from_beacon_node + web3signer key discovery in the reference VC).
+    Returns the attached pubkeys."""
+    keys = client.public_keys()
+    for pk in keys:
+        store.add_remote_validator(
+            pk, Web3SignerValidator(client, pk),
+            index=(indices or {}).get(pk),
+        )
+    return keys
+
+
+class MockWeb3Signer:
+    """In-process web3signer speaking the same REST surface, backed by raw
+    secret keys (stand-in for testing/web3signer_tests' real binary)."""
+
+    def __init__(self, secret_keys: Sequence[bls.SecretKey], port: int = 0):
+        self._by_pubkey: Dict[bytes, bls.SecretKey] = {
+            sk.public_key().to_bytes(): sk for sk in secret_keys
+        }
+        self.sign_count = 0
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, status: int, data: bytes,
+                       ctype: str = "application/json") -> None:
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/upcheck":
+                    self._reply(200, b"OK", "text/plain")
+                    return
+                if self.path == "/api/v1/eth2/publicKeys":
+                    keys = ["0x" + pk.hex() for pk in outer._by_pubkey]
+                    self._reply(200, json.dumps(keys).encode())
+                    return
+                self._reply(404, b"{}")
+
+            def do_POST(self):
+                if self.path.startswith("/api/v1/eth2/sign/0x"):
+                    pubkey = bytes.fromhex(self.path.rsplit("0x", 1)[1])
+                    sk = outer._by_pubkey.get(pubkey)
+                    if sk is None:
+                        self._reply(404, json.dumps(
+                            {"error": "unknown key"}
+                        ).encode())
+                        return
+                    length = int(self.headers.get("Content-Length", 0) or 0)
+                    body = json.loads(self.rfile.read(length))
+                    if body.get("type") not in WEB3SIGNER_TYPES:
+                        self._reply(400, json.dumps(
+                            {"error": f"unknown type {body.get('type')}"}
+                        ).encode())
+                        return
+                    root = bytes.fromhex(body["signingRoot"][2:])
+                    sig = sk.sign(root).to_bytes()
+                    outer.sign_count += 1
+                    self._reply(200, json.dumps(
+                        {"signature": "0x" + sig.hex()}
+                    ).encode())
+                    return
+                self._reply(404, b"{}")
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self.server.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+
+    def start(self) -> "MockWeb3Signer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()

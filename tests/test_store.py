@@ -291,3 +291,77 @@ def test_split_and_anchor_metadata(chain):
     a = db.get_anchor_info()
     assert (a.anchor_slot, a.oldest_block_slot) == (128, 100)
     assert a.oldest_block_parent == b"\x02" * 32
+
+
+# ---------------------------------------------------------------------------
+# Schema versioning / migrations (schema_change/ analog)
+# ---------------------------------------------------------------------------
+
+
+def test_fresh_store_gets_current_schema(tmp_path):
+    from lighthouse_tpu.store.hot_cold import CURRENT_SCHEMA_VERSION, HotColdDB
+    from lighthouse_tpu.types.containers import minimal_types
+    from lighthouse_tpu.types.spec import minimal_spec
+
+    db = HotColdDB.open(str(tmp_path / "d"), minimal_types(), minimal_spec())
+    assert db.get_schema_version() == CURRENT_SCHEMA_VERSION
+    db.close()
+
+
+def test_v1_store_migrates_head_pointer(tmp_path):
+    """A populated pre-versioning datadir (no schema key, no head key) is
+    treated as v1 and upgraded: the head pointer backfills from the
+    highest-slot state summary."""
+    from lighthouse_tpu.store.hot_cold import (
+        CURRENT_SCHEMA_VERSION,
+        HotColdDB,
+    )
+    from lighthouse_tpu.store.kv import DBColumn
+    from lighthouse_tpu.testing.harness import BeaconChainHarness
+
+    harness = BeaconChainHarness(
+        n_validators=16, bls_backend="fake",
+        store=HotColdDB.open(
+            str(tmp_path / "d"),
+            __import__("lighthouse_tpu.types.containers",
+                       fromlist=["minimal_types"]).minimal_types(),
+            __import__("lighthouse_tpu.types.spec",
+                       fromlist=["minimal_spec"]).minimal_spec(),
+        ),
+    )
+    harness.extend_chain(3, attest=False)
+    store = harness.chain.store
+    head_root = harness.chain.head.block_root
+
+    # Simulate a v1 datadir: strip the schema + head keys.
+    store.hot.delete(DBColumn.BeaconMeta, b"schema")
+    store.hot.delete(DBColumn.BeaconMeta, b"head")
+    store.close()
+
+    from lighthouse_tpu.types.containers import minimal_types
+    from lighthouse_tpu.types.spec import minimal_spec
+
+    reopened = HotColdDB.open(str(tmp_path / "d"), minimal_types(),
+                              minimal_spec())
+    assert reopened.get_schema_version() == CURRENT_SCHEMA_VERSION
+    head = reopened.get_head_info()
+    assert head is not None
+    assert head[0] == head_root  # backfilled from the best summary
+    reopened.close()
+
+
+def test_newer_schema_refused(tmp_path):
+    import struct as _struct
+
+    import pytest as _pytest
+
+    from lighthouse_tpu.store.hot_cold import HotColdDB, StoreError
+    from lighthouse_tpu.store.kv import DBColumn
+    from lighthouse_tpu.types.containers import minimal_types
+    from lighthouse_tpu.types.spec import minimal_spec
+
+    db = HotColdDB.open(str(tmp_path / "d"), minimal_types(), minimal_spec())
+    db.hot.put(DBColumn.BeaconMeta, b"schema", _struct.pack("<Q", 99))
+    db.close()
+    with _pytest.raises(StoreError):
+        HotColdDB.open(str(tmp_path / "d"), minimal_types(), minimal_spec())

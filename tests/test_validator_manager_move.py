@@ -1,0 +1,123 @@
+"""validator-manager move + state-advance pre-computation tests."""
+import pytest
+
+from lighthouse_tpu.crypto.bls import api as bls
+from lighthouse_tpu.validator_client import ValidatorStore
+from lighthouse_tpu.validator_client.http_api import KeymanagerApi
+from lighthouse_tpu.validator_client.key_manager import (
+    KeymanagerClient,
+    move_validators,
+)
+from lighthouse_tpu.types.containers import make_types
+from lighthouse_tpu.types.spec import minimal_spec
+
+
+def test_move_validators_between_vcs():
+    spec = minimal_spec()
+    types = make_types(spec.preset)
+    src_store = ValidatorStore(types, spec)
+    dest_store = ValidatorStore(types, spec)
+    keys = [bls.SecretKey(7000 + i) for i in range(3)]
+    pks = [src_store.add_validator(sk) for sk in keys]
+    # Slashing history on the source must travel.
+    fi = {"current_version": spec.genesis_fork_version,
+          "previous_version": spec.genesis_fork_version,
+          "epoch": 0, "genesis_validators_root": b"\x00" * 32}
+    att = types.AttestationData(
+        slot=8, index=0, beacon_block_root=b"\x01" * 32,
+        source=types.Checkpoint(epoch=2, root=b"\x02" * 32),
+        target=types.Checkpoint(epoch=3, root=b"\x03" * 32),
+    )
+    src_store.sign_attestation(pks[0], att, fi)
+
+    src_api = KeymanagerApi(src_store).start()
+    dest_api = KeymanagerApi(dest_store).start()
+    try:
+        src = KeymanagerClient(src_api.url, src_api.token)
+        dest = KeymanagerClient(dest_api.url, dest_api.token)
+        moved = move_validators(
+            src, dest, ["0x" + pk.hex() for pk in pks], "passw0rd!"
+        )
+        assert moved == 3
+        assert src_store.voting_pubkeys() == []
+        assert sorted(dest_store.voting_pubkeys()) == sorted(pks)
+        # Moved slashing history protects on the destination: a regressing
+        # attestation (non-increasing target) must be refused.
+        from lighthouse_tpu.validator_client import NotSafe
+        bad = types.AttestationData(
+            slot=8, index=0, beacon_block_root=b"\x09" * 32,
+            source=types.Checkpoint(epoch=2, root=b"\x02" * 32),
+            target=types.Checkpoint(epoch=3, root=b"\x09" * 32),
+        )
+        with pytest.raises(NotSafe):
+            dest_store.sign_attestation(pks[0], bad, fi)
+    finally:
+        src_api.stop()
+        dest_api.stop()
+
+
+def test_move_skips_remote_keys():
+    spec = minimal_spec()
+    types = make_types(spec.preset)
+    src_store = ValidatorStore(types, spec)
+    dest_store = ValidatorStore(types, spec)
+    local_pk = src_store.add_validator(bls.SecretKey(123))
+    src_store.add_remote_validator(b"\xaa" * 48, lambda root: b"\x00" * 96)
+    src_api = KeymanagerApi(src_store).start()
+    dest_api = KeymanagerApi(dest_store).start()
+    try:
+        src = KeymanagerClient(src_api.url, src_api.token)
+        dest = KeymanagerClient(dest_api.url, dest_api.token)
+        moved = move_validators(
+            src, dest,
+            ["0x" + local_pk.hex(), "0x" + (b"\xaa" * 48).hex()],
+            "pw",
+        )
+        assert moved == 1
+        # The remote key stays on the source.
+        assert src_store.voting_pubkeys() == [b"\xaa" * 48]
+    finally:
+        src_api.stop()
+        dest_api.stop()
+
+
+def test_state_advance_precompute():
+    from lighthouse_tpu.testing.harness import BeaconChainHarness
+
+    h = BeaconChainHarness(n_validators=16, bls_backend="fake")
+    h.extend_chain(2, attest=False)
+    chain = h.chain
+    head_slot = chain.head.state.slot
+    assert chain.advance_head_state_to(head_slot + 1)
+    # The cached snapshot advanced; the canonical head state did not regress.
+    cached = chain.snapshot_cache.get_state_clone(chain.head.block_root)
+    assert cached.slot == head_slot + 1
+    # Pre-advanced state short-circuits the next import's process_slots and
+    # imports still work.
+    h.extend_chain(1, attest=False)
+    assert chain.head.state.slot == head_slot + 1
+
+
+def test_late_block_survives_state_advance():
+    """A pre-advanced head state must not break a LATE child block at an
+    earlier slot (the cached state cannot rewind; import falls back to the
+    store's exact post-state)."""
+    from lighthouse_tpu.testing.harness import BeaconChainHarness
+
+    h = BeaconChainHarness(n_validators=16, bls_backend="fake")
+    h.extend_chain(2, attest=False)
+    chain = h.chain
+    head_slot = chain.head.state.slot
+
+    # Build the late block BEFORE the advance poisons the cache.
+    h.advance_slot()
+    late_slot = h.current_slot
+    signed, root = h.make_block(slot=late_slot)
+
+    # Wall clock moved on; the 3/4-slot timer pre-advanced PAST late_slot.
+    h.advance_slot()
+    assert chain.advance_head_state_to(late_slot + 1)
+
+    chain.process_block(signed)  # must not raise "cannot rewind"
+    assert chain.head.block_root == root
+    assert chain.head.state.slot == late_slot

@@ -1,0 +1,158 @@
+"""Remote signing parity: local keystore vs web3signer over HTTP must
+produce identical signatures for every duty type, and a remote-signing VC
+must run duties end-to-end (reference: testing/web3signer_tests,
+signing_method.rs:80-91)."""
+
+import pytest
+
+from lighthouse_tpu.crypto.bls import api as bls
+from lighthouse_tpu.validator_client import (
+    BeaconNodeFallback,
+    MockWeb3Signer,
+    ValidatorClient,
+    ValidatorStore,
+    Web3SignerClient,
+    attach_web3signer,
+)
+
+
+@pytest.fixture(scope="module")
+def signer_rig():
+    from lighthouse_tpu.types.containers import make_types
+    from lighthouse_tpu.types.spec import minimal_spec
+
+    spec = minimal_spec()
+    types = make_types(spec.preset)
+    keys = [bls.SecretKey(1000 + i) for i in range(4)]
+    server = MockWeb3Signer(keys).start()
+    client = Web3SignerClient(server.url)
+    yield {"spec": spec, "types": types, "keys": keys,
+           "server": server, "client": client}
+    server.stop()
+
+
+def _fork_info(spec):
+    return {
+        "current_version": spec.genesis_fork_version,
+        "previous_version": spec.genesis_fork_version,
+        "epoch": 0,
+        "genesis_validators_root": b"\x11" * 32,
+    }
+
+
+def test_upcheck_and_key_discovery(signer_rig):
+    c = signer_rig["client"]
+    assert c.upcheck()
+    keys = c.public_keys()
+    assert sorted(keys) == sorted(
+        sk.public_key().to_bytes() for sk in signer_rig["keys"]
+    )
+
+
+def test_signature_parity_local_vs_remote(signer_rig):
+    """Every duty signature must be bit-identical to the local signer's
+    (the core web3signer_tests assertion)."""
+    spec, types = signer_rig["spec"], signer_rig["types"]
+    sk = signer_rig["keys"][0]
+    fork_info = _fork_info(spec)
+
+    local = ValidatorStore(types, spec)
+    pk = local.add_validator(sk)
+    remote = ValidatorStore(types, spec)
+    attach_web3signer(remote, signer_rig["client"])
+
+    att_data = types.AttestationData(
+        slot=5, index=0, beacon_block_root=b"\x22" * 32,
+        source=types.Checkpoint(epoch=0, root=b"\x33" * 32),
+        target=types.Checkpoint(epoch=1, root=b"\x44" * 32),
+    )
+    assert local.sign_attestation(pk, att_data, fork_info) == \
+        remote.sign_attestation(pk, att_data, fork_info)
+    assert local.sign_randao(pk, 3, fork_info) == \
+        remote.sign_randao(pk, 3, fork_info)
+    assert local.sign_selection_proof(pk, 9, fork_info) == \
+        remote.sign_selection_proof(pk, 9, fork_info)
+    assert local.sign_sync_committee_message(
+        pk, 7, b"\x55" * 32, fork_info
+    ) == remote.sign_sync_committee_message(pk, 7, b"\x55" * 32, fork_info)
+
+    block = types.BeaconBlock["capella"](
+        slot=6, proposer_index=0, parent_root=b"\x66" * 32,
+        state_root=b"\x77" * 32,
+        body=types.BeaconBlockBodyCapella(
+            randao_reveal=b"\x00" * 96, eth1_data=types.Eth1Data(),
+            graffiti=b"\x00" * 32, sync_aggregate=types.SyncAggregate(),
+            execution_payload=types.ExecutionPayloadCapella(),
+        ),
+    )
+    assert local.sign_block(pk, block, "capella", fork_info) == \
+        remote.sign_block(pk, block, "capella", fork_info)
+    assert signer_rig["server"].sign_count >= 5
+
+
+def test_slashing_protection_guards_remote_signing(signer_rig):
+    """The local slashing DB fires BEFORE the remote call — a double block
+    proposal never reaches the signer."""
+    from lighthouse_tpu.validator_client import NotSafe
+
+    spec, types = signer_rig["spec"], signer_rig["types"]
+    store = ValidatorStore(types, spec)
+    attach_web3signer(store, signer_rig["client"])
+    pk = signer_rig["keys"][1].public_key().to_bytes()
+
+    def block_at(root):
+        return types.BeaconBlock["capella"](
+            slot=40, proposer_index=1, parent_root=root,
+            state_root=b"\x01" * 32,
+            body=types.BeaconBlockBodyCapella(
+                randao_reveal=b"\x00" * 96, eth1_data=types.Eth1Data(),
+                graffiti=b"\x00" * 32, sync_aggregate=types.SyncAggregate(),
+                execution_payload=types.ExecutionPayloadCapella(),
+            ),
+        )
+
+    fork_info = _fork_info(spec)
+    store.sign_block(pk, block_at(b"\xaa" * 32), "capella", fork_info)
+    before = signer_rig["server"].sign_count
+    with pytest.raises(NotSafe):
+        store.sign_block(pk, block_at(b"\xbb" * 32), "capella", fork_info)
+    assert signer_rig["server"].sign_count == before  # never reached signer
+
+
+def test_vc_duties_through_remote_signer():
+    """A VC whose keys live in web3signer attests and proposes over real
+    HTTP on both boundaries (BN API + signer API)."""
+    from lighthouse_tpu.common.eth2_client import BeaconNodeHttpClient
+    from lighthouse_tpu.http_api import BeaconApiServer
+    from lighthouse_tpu.op_pool import OperationPool
+    from lighthouse_tpu.testing.harness import BeaconChainHarness
+
+    harness = BeaconChainHarness(n_validators=16)
+    chain = harness.chain
+    chain.op_pool = OperationPool(harness.types, harness.spec)
+    api = BeaconApiServer(chain).start()
+    signer = MockWeb3Signer(harness.keys).start()
+    try:
+        store = ValidatorStore(harness.types, harness.spec)
+        attach_web3signer(
+            store, Web3SignerClient(signer.url),
+            indices={sk.public_key().to_bytes(): i
+                     for i, sk in enumerate(harness.keys)},
+        )
+        vc = ValidatorClient(
+            store, BeaconNodeFallback([BeaconNodeHttpClient(api.url)]),
+            harness.types, harness.spec,
+        )
+        produced = {"blocks": 0, "attestations": 0}
+        for _ in range(2):
+            harness.advance_slot()
+            stats = vc.run_slot(harness.current_slot)
+            produced["blocks"] += stats["blocks"]
+            produced["attestations"] += stats["attestations"]
+        assert produced["blocks"] == 2
+        assert produced["attestations"] > 0
+        assert chain.head.state.slot == harness.current_slot
+        assert signer.sign_count > 0
+    finally:
+        api.stop()
+        signer.stop()
