@@ -197,7 +197,9 @@ def verify_chain_segment(chain, blocks: List[object]) -> List[SignatureVerifiedB
             raise BlockError("NonLinearSegment")
 
     parent_root = bytes(blocks[0].message.parent_root)
-    state = chain.state_for_block_import(parent_root)
+    state = chain.state_for_block_import(
+        parent_root, max_slot=blocks[0].message.slot
+    )
     if state is None:
         raise BlockError("ParentUnknown", parent_root.hex())
 

@@ -149,6 +149,7 @@ class SnapshotCache:
     def __init__(self, max_snapshots: int = 4):
         self.max = max_snapshots
         self._map: "OrderedDict[bytes, tuple]" = OrderedDict()
+        self._advanced: "OrderedDict[bytes, object]" = OrderedDict()
         self._lock = threading.Lock()
 
     def insert(self, block_root: bytes, state, signed_block=None) -> None:
@@ -159,19 +160,26 @@ class SnapshotCache:
                 self._map.popitem(last=False)
 
     def get_state_clone(self, block_root: bytes):
+        """EXACT post-state of the block (head snapshots, re-orgs)."""
         with self._lock:
             hit = self._map.get(block_root)
         if hit is None:
             return None
         return hit[0].copy()
 
-    def update_state(self, block_root: bytes, state) -> None:
-        """Replace an entry's state (the state-advance pre-computation),
-        keeping its block."""
+    def set_advanced(self, block_root: bytes, state) -> None:
+        """Store a pre-advanced variant (state_advance_timer) WITHOUT
+        touching the exact post-state — head queries keep seeing the state
+        at the block's slot; only the import fast-path consumes this."""
         with self._lock:
-            prev = self._map.get(block_root)
-            self._map[block_root] = (state, prev[1] if prev else None)
-            self._map.move_to_end(block_root)
+            self._advanced[block_root] = state
+            while len(self._advanced) > 2:
+                self._advanced.popitem(last=False)
+
+    def get_advanced_clone(self, block_root: bytes):
+        with self._lock:
+            hit = self._advanced.get(block_root)
+        return hit.copy() if hit is not None else None
 
     def contains(self, block_root: bytes) -> bool:
         with self._lock:

@@ -215,9 +215,12 @@ class BeaconChain:
         the state-advance pre-computation: a cached state advanced PAST the
         child's slot cannot be rewound, so a late block falls back to the
         store's exact post-state."""
+        adv = self.snapshot_cache.get_advanced_clone(parent_block_root)
+        if adv is not None and (max_slot is None or adv.slot <= max_slot):
+            return adv
         state = self.snapshot_cache.get_state_clone(parent_block_root)
-        if state is not None and (max_slot is None or state.slot <= max_slot):
-            return state
+        if state is not None:
+            return state  # exact post-state: never past a child's slot
         state_root = self._state_root_by_block.get(parent_block_root)
         if state_root is None:
             parent = self.store.get_block(parent_block_root)
@@ -694,18 +697,24 @@ class BeaconChain:
 
     def advance_head_state_to(self, slot: int) -> bool:
         """state_advance_timer.rs:98: pre-compute the head state advanced to
-        `slot` (usually next slot, 3/4 through the current one) into the
-        snapshot cache, so the next block's import and next-slot attestation
-        production skip their process_slots. Returns True when work ran."""
-        with self._lock:
-            root = self.head.block_root
-            state = self.snapshot_cache.get_state_clone(root)
-            if state is None:
+        `slot` (usually next slot, 3/4 through the current one) as a
+        SEPARATE snapshot-cache variant, so the next block's import skips
+        its process_slots while exact post-states stay untouched. The
+        (possibly multi-slot / epoch-boundary) transition runs on a clone
+        OUTSIDE the chain lock — the timer must not stall imports. Returns
+        True when work ran."""
+        root = self.head.block_root
+        state = self.snapshot_cache.get_state_clone(root)
+        if state is None:
+            with self._lock:
                 state = self.head.state.copy()
-            if state.slot >= slot:
-                return False
-            state = sp.process_slots(state, self.types, self.spec, slot)
-            self.snapshot_cache.update_state(root, state)
+        if state.slot >= slot:
+            return False
+        state = sp.process_slots(state, self.types, self.spec, slot)
+        with self._lock:
+            if self.head.block_root != root:
+                return False  # head moved while advancing: discard
+            self.snapshot_cache.set_advanced(root, state)
             return True
 
     # ----------------------------------------------------------------- head

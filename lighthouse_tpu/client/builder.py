@@ -41,7 +41,8 @@ class ClientConfig:
     (checkpoint_state_ssz+checkpoint_block_ssz) > GenesisState ssz >
     FromStore (resume, when the datadir already has a head) > Interop."""
 
-    preset: str = "minimal"                  # "mainnet" | "minimal"
+    preset: str = "minimal"                  # network name (--network):
+    #   minimal | mainnet | sepolia | holesky | gnosis | chiado
     datadir: Optional[str] = None            # None => memory store
     n_interop_validators: int = 64
     genesis_time: int = 1_600_000_000
@@ -137,7 +138,9 @@ class ClientBuilder:
 
     def build(self, transport=None, peer_id: str = "node") -> Client:
         cfg = self.config
-        spec = minimal_spec() if cfg.preset == "minimal" else mainnet_spec()
+        from lighthouse_tpu.types.networks import spec_for_network
+
+        spec = spec_for_network(cfg.preset)
         types = make_types(spec.preset)
 
         # --- store (builder.rs:1030 disk_store) --------------------------

@@ -207,8 +207,12 @@ class SignatureSet:
 # ---------------------------------------------------------------------------
 
 
-def _sig_in_subgroup(sig: Signature) -> bool:
-    return sig.subgroup_checked or c.g2_in_subgroup(sig.point)
+def _sig_in_subgroup(sig) -> bool:
+    # `sig` may be a Signature (carries its deserialization-time subgroup
+    # flag) or an AggregateSignature (aggregation of checked points — no
+    # flag; re-check the point).
+    return getattr(sig, "subgroup_checked", False) or \
+        c.g2_in_subgroup(sig.point)
 
 
 def verify(pubkey: PublicKey, message: bytes, signature: Signature) -> bool:

@@ -179,6 +179,33 @@ class BeaconApiServer:
                 ),
             }}
 
+        if path == "/eth/v1/config/fork_schedule":
+            from lighthouse_tpu.types.networks import fork_schedule
+
+            return {"data": list(fork_schedule(spec).values())}
+        if path == "/eth/v1/config/deposit_contract":
+            return {"data": {
+                "chain_id": str(spec.deposit_chain_id),
+                "address": "0x" + spec.deposit_contract_address.hex(),
+            }}
+        if path == "/eth/v1/config/spec":
+            out = {
+                "CONFIG_NAME": spec.config_name,
+                "PRESET_BASE": spec.preset.name,
+                "SECONDS_PER_SLOT": str(spec.seconds_per_slot),
+                "SLOTS_PER_EPOCH": str(spec.preset.SLOTS_PER_EPOCH),
+                "GENESIS_FORK_VERSION":
+                    "0x" + spec.genesis_fork_version.hex(),
+                "MAX_EFFECTIVE_BALANCE": str(spec.max_effective_balance),
+                "MIN_GENESIS_ACTIVE_VALIDATOR_COUNT":
+                    str(spec.min_genesis_active_validator_count),
+                "DEPOSIT_CHAIN_ID": str(spec.deposit_chain_id),
+                "DEPOSIT_NETWORK_ID": str(spec.deposit_network_id),
+                "DEPOSIT_CONTRACT_ADDRESS":
+                    "0x" + spec.deposit_contract_address.hex(),
+            }
+            return {"data": out}
+
         if path == "/eth/v1/beacon/genesis":
             state = chain.head.state
             return {"data": {

@@ -176,7 +176,8 @@ class HotColdDB:
         while v < CURRENT_SCHEMA_VERSION:
             _MIGRATIONS[v + 1](self)
             v += 1
-        self._put_schema_version(v)
+        if v != self.get_schema_version():
+            self._put_schema_version(v)
 
     @classmethod
     def open(cls, path: str, types, spec, config: Optional[StoreConfig] = None):

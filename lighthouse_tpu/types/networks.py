@@ -1,0 +1,166 @@
+"""Embedded network configurations.
+
+Reference: `common/eth2_network_config` + `common/eth2_config`
+(eth2_config/src/lib.rs:277-344) embed the published config for each
+supported network (mainnet, sepolia, holesky, gnosis, chiado) so a node can
+join by name (`--network sepolia`). Here each network is a ChainSpec
+carrying its fork schedule (version bytes + activation epochs), timing, and
+deposit-contract parameters, as published in the consensus-specs config
+files for those networks.
+
+All networks run the mainnet *preset* (compile-time constants); only the
+runtime ChainSpec differs — the same split the reference's EthSpec/ChainSpec
+pair makes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from .spec import ChainSpec, mainnet_spec, minimal_spec
+
+
+def _hex(s: str) -> bytes:
+    return bytes.fromhex(s)
+
+
+def sepolia_spec() -> ChainSpec:
+    return ChainSpec(
+        config_name="sepolia",
+        genesis_fork_version=_hex("90000069"),
+        altair_fork_version=_hex("90000070"),
+        altair_fork_epoch=50,
+        bellatrix_fork_version=_hex("90000071"),
+        bellatrix_fork_epoch=100,
+        capella_fork_version=_hex("90000072"),
+        capella_fork_epoch=56832,
+        deneb_fork_version=_hex("90000073"),
+        deneb_fork_epoch=132608,
+        min_genesis_time=1655647200,
+        genesis_delay=86400,
+        min_genesis_active_validator_count=1300,
+        deposit_chain_id=11155111,
+        deposit_network_id=11155111,
+        deposit_contract_address=_hex(
+            "7f02c3e3c98b133055b8b348b2ac625669ed295d"
+        ),
+    )
+
+
+def holesky_spec() -> ChainSpec:
+    return ChainSpec(
+        config_name="holesky",
+        genesis_fork_version=_hex("01017000"),
+        altair_fork_version=_hex("02017000"),
+        altair_fork_epoch=0,
+        bellatrix_fork_version=_hex("03017000"),
+        bellatrix_fork_epoch=0,
+        capella_fork_version=_hex("04017000"),
+        capella_fork_epoch=256,
+        deneb_fork_version=_hex("05017000"),
+        deneb_fork_epoch=29696,
+        min_genesis_time=1695902100,
+        genesis_delay=300,
+        min_genesis_active_validator_count=16384,
+        deposit_chain_id=17000,
+        deposit_network_id=17000,
+        deposit_contract_address=_hex(
+            "4242424242424242424242424242424242424242"
+        ),
+    )
+
+
+def gnosis_spec() -> ChainSpec:
+    return ChainSpec(
+        config_name="gnosis",
+        genesis_fork_version=_hex("00000064"),
+        altair_fork_version=_hex("01000064"),
+        altair_fork_epoch=512,
+        bellatrix_fork_version=_hex("02000064"),
+        bellatrix_fork_epoch=385536,
+        capella_fork_version=_hex("03000064"),
+        capella_fork_epoch=648704,
+        deneb_fork_version=_hex("04000064"),
+        deneb_fork_epoch=889856,
+        seconds_per_slot=5,
+        min_genesis_time=1638968400,
+        genesis_delay=6000,
+        min_genesis_active_validator_count=4096,
+        churn_limit_quotient=4096,
+        deposit_chain_id=100,
+        deposit_network_id=100,
+        deposit_contract_address=_hex(
+            "0b98057ea310f4d31f2a452b414647007d1645d9"
+        ),
+    )
+
+
+def chiado_spec() -> ChainSpec:
+    return ChainSpec(
+        config_name="chiado",
+        genesis_fork_version=_hex("0000006f"),
+        altair_fork_version=_hex("0100006f"),
+        altair_fork_epoch=90,
+        bellatrix_fork_version=_hex("0200006f"),
+        bellatrix_fork_epoch=180,
+        capella_fork_version=_hex("0300006f"),
+        capella_fork_epoch=244224,
+        deneb_fork_version=_hex("0400006f"),
+        deneb_fork_epoch=516608,
+        seconds_per_slot=5,
+        min_genesis_time=1665396000,
+        genesis_delay=300,
+        min_genesis_active_validator_count=6000,
+        churn_limit_quotient=4096,
+        deposit_chain_id=10200,
+        deposit_network_id=10200,
+        deposit_contract_address=_hex(
+            "b97036a26259b7147018913bd58a774cf91acf25"
+        ),
+    )
+
+
+_NETWORKS = {
+    "mainnet": mainnet_spec,
+    "minimal": minimal_spec,
+    "sepolia": sepolia_spec,
+    "holesky": holesky_spec,
+    "gnosis": gnosis_spec,
+    "chiado": chiado_spec,
+}
+
+
+def network_names() -> List[str]:
+    return sorted(_NETWORKS)
+
+
+def spec_for_network(name: str) -> ChainSpec:
+    """`--network <name>` resolution (HARDCODED_NET_NAMES analog)."""
+    try:
+        return _NETWORKS[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown network {name!r}; supported: {', '.join(network_names())}"
+        )
+
+
+def fork_schedule(spec: ChainSpec) -> Dict[str, dict]:
+    """The /eth/v1/config/fork_schedule view of a spec."""
+    out = {}
+    prev_version = spec.genesis_fork_version
+    for fork, version, epoch in (
+        ("phase0", spec.genesis_fork_version, 0),
+        ("altair", spec.altair_fork_version, spec.altair_fork_epoch),
+        ("bellatrix", spec.bellatrix_fork_version, spec.bellatrix_fork_epoch),
+        ("capella", spec.capella_fork_version, spec.capella_fork_epoch),
+        ("deneb", spec.deneb_fork_version, spec.deneb_fork_epoch),
+    ):
+        if epoch is None:
+            continue
+        out[fork] = {
+            "previous_version": "0x" + prev_version.hex(),
+            "current_version": "0x" + version.hex(),
+            "epoch": str(epoch),
+        }
+        prev_version = version
+    return out

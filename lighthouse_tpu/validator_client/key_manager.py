@@ -115,11 +115,21 @@ def move_validators(src: KeymanagerClient, dest: KeymanagerClient,
     ]
     if not moved_keys:
         return 0
-    src.delete_keystores([pk for pk, _ in moved_keys])
+    deleted = src.delete_keystores([pk for pk, _ in moved_keys])
+    # The DELETE response's interchange is the authoritative one: it
+    # includes anything signed between export and delete. Filter it to the
+    # moving keys (the full-store dump would seed the destination with
+    # unrelated validators' records).
+    interchange = json.loads(deleted["slashing_protection"])
+    wanted = {pk.lower() for pk, _ in moved_keys}
+    interchange["data"] = [
+        rec for rec in interchange.get("data", [])
+        if rec.get("pubkey", "").lower() in wanted
+    ]
     dest_out = dest.import_keystores(
         [k for _, k in moved_keys],
         [password] * len(moved_keys),
-        slashing_protection=out["slashing_protection"],
+        slashing_protection=json.dumps(interchange),
     )
     return sum(1 for st in dest_out["data"] if st["status"] == "imported")
 

@@ -55,7 +55,7 @@ class Web3SignerClient:
             raise Web3SignerError(f"publicKeys failed: {e}")
 
     def sign(self, pubkey: bytes, signing_root: bytes,
-             type_: str = "BEACON_BLOCK") -> bytes:
+             type_: str = "BLOCK_V2") -> bytes:
         body = json.dumps({
             "type": type_,
             "signingRoot": "0x" + signing_root.hex(),

@@ -1,0 +1,435 @@
+#!/usr/bin/env python
+"""Generate the committed conformance vectors under tests/vectors/.
+
+Reference workflow: `testing/ef_tests` consumes the consensus-spec-tests
+download. No egress here, so this script plays the generator role: positive
+cases freeze current behavior as regression anchors; negative cases
+(tampered signatures, malformed points, wrong roots, premature exits)
+have a-priori-known outcomes independent of the implementation.
+
+Deterministic: fixed keys/messages, no clock, no randomness. Re-run after
+intentional behavior changes; the diff shows exactly what moved.
+
+    JAX_PLATFORMS=cpu python scripts/gen_vectors.py
+"""
+
+import json
+import os
+import shutil
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lighthouse_tpu.testing.ef_tests import VECTOR_ROOT  # noqa: E402
+
+
+def case_dir(config, fork, runner, handler, suite, case):
+    d = os.path.join(VECTOR_ROOT, config, fork, runner, handler, suite, case)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def write_meta(d, meta):
+    with open(os.path.join(d, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=1, sort_keys=True)
+
+
+def write_ssz(d, name, data: bytes):
+    with open(os.path.join(d, name), "wb") as f:
+        f.write(data)
+
+
+def hx(b: bytes) -> str:
+    return "0x" + bytes(b).hex()
+
+
+# ---------------------------------------------------------------------- BLS
+
+
+def gen_bls():
+    from lighthouse_tpu.crypto.bls import api as bls
+
+    sks = [bls.SecretKey(0xA11CE + i) for i in range(4)]
+    pks = [sk.public_key() for sk in sks]
+    msg = b"\x5a" * 32
+    msg2 = b"\xa5" * 32
+
+    # verify: valid / wrong message / tampered sig / infinity pubkey /
+    # malformed pubkey (not on curve)
+    sig = sks[0].sign(msg)
+    d = case_dir("general", "phase0", "bls", "verify", "small", "valid")
+    write_meta(d, {"input": {"pubkey": hx(pks[0].to_bytes()),
+                             "message": hx(msg),
+                             "signature": hx(sig.to_bytes())},
+                   "output": True})
+    d = case_dir("general", "phase0", "bls", "verify", "small", "wrong_msg")
+    write_meta(d, {"input": {"pubkey": hx(pks[0].to_bytes()),
+                             "message": hx(msg2),
+                             "signature": hx(sig.to_bytes())},
+                   "output": False})
+    bad_sig = bytearray(sig.to_bytes())
+    bad_sig[-1] ^= 1
+    d = case_dir("general", "phase0", "bls", "verify", "small", "tampered_sig")
+    write_meta(d, {"input": {"pubkey": hx(pks[0].to_bytes()),
+                             "message": hx(msg),
+                             "signature": hx(bytes(bad_sig))},
+                   "output": False})
+    d = case_dir("general", "phase0", "bls", "verify", "small",
+                 "infinity_pubkey")
+    write_meta(d, {"input": {"pubkey": hx(b"\xc0" + b"\x00" * 47),
+                             "message": hx(msg),
+                             "signature": hx(sig.to_bytes())},
+                   "output": False})
+    d = case_dir("general", "phase0", "bls", "verify", "small",
+                 "malformed_pubkey")
+    write_meta(d, {"input": {"pubkey": hx(b"\x8f" + b"\x11" * 47),
+                             "message": hx(msg),
+                             "signature": hx(sig.to_bytes())},
+                   "output": False})
+
+    # aggregate_verify: distinct messages
+    sigs = [sk.sign(m) for sk, m in zip(sks[:3], [msg, msg2, b"\x33" * 32])]
+    agg = bls.AggregateSignature.aggregate(sigs)
+    d = case_dir("general", "phase0", "bls", "aggregate_verify", "small",
+                 "valid")
+    write_meta(d, {"input": {
+        "pubkeys": [hx(p.to_bytes()) for p in pks[:3]],
+        "messages": [hx(msg), hx(msg2), hx(b"\x33" * 32)],
+        "signature": hx(agg.to_bytes())}, "output": True})
+    d = case_dir("general", "phase0", "bls", "aggregate_verify", "small",
+                 "swapped_messages")
+    write_meta(d, {"input": {
+        "pubkeys": [hx(p.to_bytes()) for p in pks[:3]],
+        "messages": [hx(msg2), hx(msg), hx(b"\x33" * 32)],
+        "signature": hx(agg.to_bytes())}, "output": False})
+
+    # fast_aggregate_verify: same message
+    fsigs = [sk.sign(msg) for sk in sks]
+    fagg = bls.AggregateSignature.aggregate(fsigs)
+    d = case_dir("general", "phase0", "bls", "fast_aggregate_verify",
+                 "small", "valid")
+    write_meta(d, {"input": {
+        "pubkeys": [hx(p.to_bytes()) for p in pks],
+        "message": hx(msg),
+        "signature": hx(fagg.to_bytes())}, "output": True})
+    d = case_dir("general", "phase0", "bls", "fast_aggregate_verify",
+                 "small", "extra_pubkey")
+    write_meta(d, {"input": {
+        "pubkeys": [hx(p.to_bytes()) for p in pks[:3]],
+        "message": hx(msg),
+        "signature": hx(fagg.to_bytes())}, "output": False})
+    d = case_dir("general", "phase0", "bls", "fast_aggregate_verify",
+                 "small", "no_pubkeys")
+    write_meta(d, {"input": {
+        "pubkeys": [], "message": hx(msg),
+        "signature": hx(bls.AggregateSignature.infinity().to_bytes())},
+        "output": False})
+
+    # batch_verify (the north-star entry point)
+    def set_json(sk_group, m):
+        ss = [sk.sign(m) for sk in sk_group]
+        a = bls.AggregateSignature.aggregate(ss)
+        return {"signature": hx(a.to_bytes()),
+                "pubkeys": [hx(sk.public_key().to_bytes())
+                            for sk in sk_group],
+                "message": hx(m)}
+
+    valid_sets = [set_json(sks[:2], msg), set_json(sks[2:], msg2),
+                  set_json([sks[1]], b"\x77" * 32)]
+    d = case_dir("general", "phase0", "bls", "batch_verify", "small",
+                 "all_valid")
+    write_meta(d, {"input": {"sets": valid_sets}, "output": True})
+    poisoned = [dict(s) for s in valid_sets]
+    poisoned[1] = dict(poisoned[1], message=hx(b"\x99" * 32))
+    d = case_dir("general", "phase0", "bls", "batch_verify", "small",
+                 "one_poisoned")
+    write_meta(d, {"input": {"sets": poisoned}, "output": False})
+    d = case_dir("general", "phase0", "bls", "batch_verify", "small",
+                 "single_set")
+    write_meta(d, {"input": {"sets": [set_json(sks, msg)]}, "output": True})
+
+
+# ----------------------------------------------------------------- ssz etc.
+
+
+def gen_consensus():
+    from lighthouse_tpu.testing.harness import BeaconChainHarness
+    from lighthouse_tpu.types.spec import minimal_spec
+
+    spec = minimal_spec()
+    h = BeaconChainHarness(n_validators=16, genesis_time=1_600_000_000)
+    types = h.types
+    fork = "capella"
+    scls = types.BeaconState[fork]
+
+    # --- ssz_static -------------------------------------------------------
+    genesis = h.chain.head.state
+    samples = {
+        "Checkpoint": (types.Checkpoint,
+                       types.Checkpoint(epoch=3, root=b"\x42" * 32)),
+        "AttestationData": (types.AttestationData, types.AttestationData(
+            slot=9, index=1, beacon_block_root=b"\x01" * 32,
+            source=types.Checkpoint(epoch=0, root=b"\x02" * 32),
+            target=types.Checkpoint(epoch=1, root=b"\x03" * 32))),
+        "BeaconBlockHeader": (types.BeaconBlockHeader,
+                              genesis.latest_block_header),
+        "Validator": (types.Validator, genesis.validators[0]),
+        "Fork": (types.Fork, genesis.fork),
+        "Eth1Data": (types.Eth1Data, genesis.eth1_data),
+        "SyncAggregate": (types.SyncAggregate, types.SyncAggregate()),
+        "BeaconState": (scls, genesis),
+    }
+    for name, (cls, obj) in samples.items():
+        d = case_dir("minimal", fork, "ssz_static", "containers",
+                     "suite", name)
+        write_ssz(d, "serialized.ssz", cls.serialize(obj))
+        write_meta(d, {"type": name, "root": hx(cls.hash_tree_root(obj))})
+
+    # --- shuffling --------------------------------------------------------
+    from lighthouse_tpu.state_transition.helpers import compute_shuffled_index
+
+    for count in (8, 33):
+        seed = bytes([count]) * 32
+        rounds = spec.preset.SHUFFLE_ROUND_COUNT
+        d = case_dir("minimal", "phase0", "shuffling", "core", "suite",
+                     f"count_{count}")
+        write_meta(d, {
+            "seed": hx(seed), "count": count, "rounds": rounds,
+            "mapping": [compute_shuffled_index(i, count, seed, rounds)
+                        for i in range(count)],
+        })
+
+    # --- sanity/slots -----------------------------------------------------
+    from lighthouse_tpu.state_transition import slot_processing as sp
+
+    pre = genesis.copy()
+    post = sp.process_slots(genesis.copy(), types, spec, pre.slot + 5)
+    d = case_dir("minimal", fork, "sanity", "slots", "suite", "five_slots")
+    write_ssz(d, "pre.ssz", scls.serialize(pre))
+    write_ssz(d, "post.ssz", scls.serialize(post))
+    write_meta(d, {"slots": 5})
+
+    # --- sanity/blocks (REAL signatures, verified by the runner) ----------
+    pre_blocks_state = h.chain.head.state.copy()
+    produced = h.extend_chain(2, attest=True)
+    d = case_dir("minimal", fork, "sanity", "blocks", "suite", "two_blocks")
+    write_ssz(d, "pre.ssz", scls.serialize(pre_blocks_state))
+    for i, (_root, signed) in enumerate(produced):
+        write_ssz(d, f"blocks_{i}.ssz",
+                  types.SignedBeaconBlock[fork].serialize(signed))
+    write_ssz(d, "post.ssz", scls.serialize(
+        h.chain.store.get_state(
+            h.chain._state_root_by_block[h.chain.head.block_root]
+        )
+    ))
+    write_meta(d, {"blocks_count": 2, "valid": True})
+
+    # invalid: same chain but the last block's state_root is corrupted
+    d = case_dir("minimal", fork, "sanity", "blocks", "suite",
+                 "bad_state_root")
+    write_ssz(d, "pre.ssz", scls.serialize(pre_blocks_state))
+    bad = produced[0][1].copy()
+    bad.message.state_root = b"\xde" * 32
+    write_ssz(d, "blocks_0.ssz", types.SignedBeaconBlock[fork].serialize(bad))
+    write_meta(d, {"blocks_count": 1, "valid": False})
+
+    # invalid: bad proposer signature
+    d = case_dir("minimal", fork, "sanity", "blocks", "suite",
+                 "bad_signature")
+    write_ssz(d, "pre.ssz", scls.serialize(pre_blocks_state))
+    forged = produced[0][1].copy()
+    forged.signature = h.keys[0].sign(b"\x13" * 32).to_bytes()
+    write_ssz(d, "blocks_0.ssz",
+              types.SignedBeaconBlock[fork].serialize(forged))
+    write_meta(d, {"blocks_count": 1, "valid": False})
+
+    # --- operations -------------------------------------------------------
+    # attestation (valid): produced by the harness for the previous slot.
+    state_for_ops = h.chain.head.state.copy()
+    state_for_ops = sp.process_slots(
+        state_for_ops, types, spec, state_for_ops.slot + 1
+    )
+    atts = h.make_attestations(h.chain.head.state.slot)
+    d = case_dir("minimal", fork, "operations", "attestation", "suite",
+                 "valid")
+    write_ssz(d, "pre.ssz", scls.serialize(state_for_ops))
+    write_ssz(d, "attestation.ssz", types.Attestation.serialize(atts[0]))
+    post_ops = state_for_ops.copy()
+    from lighthouse_tpu.testing.ef_tests import _apply_operation
+
+    _apply_operation("attestation", post_ops, types, spec, fork,
+                     types.Attestation.serialize(atts[0]))
+    write_ssz(d, "post.ssz", scls.serialize(post_ops))
+    write_meta(d, {"valid": True})
+
+    # attestation (invalid): aggregation bits cleared
+    d = case_dir("minimal", fork, "operations", "attestation", "suite",
+                 "no_bits")
+    write_ssz(d, "pre.ssz", scls.serialize(state_for_ops))
+    empty = atts[0].copy()
+    empty.aggregation_bits = [False] * len(list(atts[0].aggregation_bits))
+    write_ssz(d, "attestation.ssz", types.Attestation.serialize(empty))
+    write_meta(d, {"valid": False})
+
+    # voluntary_exit (invalid: validator too young — a-priori outcome)
+    from lighthouse_tpu.types.spec import (
+        DOMAIN_VOLUNTARY_EXIT,
+        compute_signing_root,
+        get_domain,
+    )
+
+    exit_msg = types.VoluntaryExit(epoch=0, validator_index=2)
+    domain = get_domain(
+        spec, DOMAIN_VOLUNTARY_EXIT, 0,
+        state_for_ops.fork.current_version,
+        state_for_ops.fork.previous_version, state_for_ops.fork.epoch,
+        state_for_ops.genesis_validators_root,
+    )
+    root = compute_signing_root(exit_msg, types.VoluntaryExit, domain)
+    signed_exit = types.SignedVoluntaryExit(
+        message=exit_msg, signature=h.keys[2].sign(root).to_bytes()
+    )
+    d = case_dir("minimal", fork, "operations", "voluntary_exit", "suite",
+                 "premature")
+    write_ssz(d, "pre.ssz", scls.serialize(state_for_ops))
+    write_ssz(d, "voluntary_exit.ssz",
+              types.SignedVoluntaryExit.serialize(signed_exit))
+    write_meta(d, {"valid": False})
+
+    # proposer_slashing (valid: two signed headers, same slot)
+    from lighthouse_tpu.types.spec import DOMAIN_BEACON_PROPOSER
+
+    hdr_domain = get_domain(
+        spec, DOMAIN_BEACON_PROPOSER,
+        spec.epoch_at_slot(state_for_ops.slot),
+        state_for_ops.fork.current_version,
+        state_for_ops.fork.previous_version, state_for_ops.fork.epoch,
+        state_for_ops.genesis_validators_root,
+    )
+
+    def signed_header(proposer, parent):
+        hdr = types.BeaconBlockHeader(
+            slot=state_for_ops.slot, proposer_index=proposer,
+            parent_root=parent, state_root=b"\x00" * 32,
+            body_root=b"\x00" * 32,
+        )
+        r = compute_signing_root(hdr, types.BeaconBlockHeader, hdr_domain)
+        return types.SignedBeaconBlockHeader(
+            message=hdr, signature=h.keys[proposer].sign(r).to_bytes()
+        )
+
+    slashing = types.ProposerSlashing(
+        signed_header_1=signed_header(3, b"\x01" * 32),
+        signed_header_2=signed_header(3, b"\x02" * 32),
+    )
+    d = case_dir("minimal", fork, "operations", "proposer_slashing",
+                 "suite", "valid")
+    write_ssz(d, "pre.ssz", scls.serialize(state_for_ops))
+    write_ssz(d, "proposer_slashing.ssz",
+              types.ProposerSlashing.serialize(slashing))
+    post_ops = state_for_ops.copy()
+    _apply_operation("proposer_slashing", post_ops, types, spec, fork,
+                     types.ProposerSlashing.serialize(slashing))
+    write_ssz(d, "post.ssz", scls.serialize(post_ops))
+    write_meta(d, {"valid": True})
+
+    # proposer_slashing (invalid: identical headers)
+    same = types.ProposerSlashing(
+        signed_header_1=signed_header(4, b"\x01" * 32),
+        signed_header_2=signed_header(4, b"\x01" * 32),
+    )
+    d = case_dir("minimal", fork, "operations", "proposer_slashing",
+                 "suite", "same_header")
+    write_ssz(d, "pre.ssz", scls.serialize(state_for_ops))
+    write_ssz(d, "proposer_slashing.ssz",
+              types.ProposerSlashing.serialize(same))
+    write_meta(d, {"valid": False})
+
+    # attester_slashing (valid: double vote for validator 5)
+    from lighthouse_tpu.types.spec import DOMAIN_BEACON_ATTESTER
+
+    att_domain = get_domain(
+        spec, DOMAIN_BEACON_ATTESTER, 0,
+        state_for_ops.fork.current_version,
+        state_for_ops.fork.previous_version, state_for_ops.fork.epoch,
+        state_for_ops.genesis_validators_root,
+    )
+
+    def indexed(att_root):
+        data = types.AttestationData(
+            slot=0, index=0, beacon_block_root=att_root,
+            source=types.Checkpoint(epoch=0, root=b"\x0a" * 32),
+            target=types.Checkpoint(epoch=0, root=att_root),
+        )
+        r = compute_signing_root(data, types.AttestationData, att_domain)
+        return types.IndexedAttestation(
+            attesting_indices=[5], data=data,
+            signature=h.keys[5].sign(r).to_bytes(),
+        )
+
+    aslash = types.AttesterSlashing(
+        attestation_1=indexed(b"\x0b" * 32),
+        attestation_2=indexed(b"\x0c" * 32),
+    )
+    d = case_dir("minimal", fork, "operations", "attester_slashing",
+                 "suite", "double_vote")
+    write_ssz(d, "pre.ssz", scls.serialize(state_for_ops))
+    write_ssz(d, "attester_slashing.ssz",
+              types.AttesterSlashing.serialize(aslash))
+    post_ops = state_for_ops.copy()
+    _apply_operation("attester_slashing", post_ops, types, spec, fork,
+                     types.AttesterSlashing.serialize(aslash))
+    write_ssz(d, "post.ssz", scls.serialize(post_ops))
+    write_meta(d, {"valid": True})
+
+    # --- epoch_processing -------------------------------------------------
+    pre_epoch = sp.process_slots(
+        genesis.copy(), types, spec,
+        spec.preset.SLOTS_PER_EPOCH - 1
+    )
+    post_epoch = sp.process_slots(
+        pre_epoch.copy(), types, spec, spec.preset.SLOTS_PER_EPOCH
+    )
+    d = case_dir("minimal", fork, "epoch_processing", "full", "suite",
+                 "first_boundary")
+    write_ssz(d, "pre.ssz", scls.serialize(pre_epoch))
+    write_ssz(d, "post.ssz", scls.serialize(post_epoch))
+    write_meta(d, {})
+
+    # --- fork_choice scripted (hand-checkable LMD votes) ------------------
+    A, B, C = b"\xaa" * 32, b"\xbb" * 32, b"\xcc" * 32
+    anchor = b"\x00" * 32
+    d = case_dir("minimal", "phase0", "fork_choice", "scripted", "suite",
+                 "simple_fork")
+    write_meta(d, {
+        "anchor": hx(anchor), "validators": 8,
+        "steps": [
+            {"op": "block", "slot": 1, "root": hx(A), "parent": hx(anchor)},
+            {"op": "block", "slot": 2, "root": hx(B), "parent": hx(A)},
+            {"op": "block", "slot": 2, "root": hx(C), "parent": hx(A)},
+            # 2 votes B vs 1 vote C -> head B (pure LMD weight).
+            {"op": "attestation", "current_slot": 3, "validators": [0, 1],
+             "root": hx(B), "target_epoch": 0, "slot": 2},
+            {"op": "attestation", "current_slot": 3, "validators": [2],
+             "root": hx(C), "target_epoch": 0, "slot": 2},
+            {"op": "head", "current_slot": 3, "expect": hx(B)},
+            # C gains 2 more distinct votes -> 3 vs 2, head flips to C.
+            {"op": "attestation", "current_slot": 4, "validators": [3, 4],
+             "root": hx(C), "target_epoch": 0, "slot": 3},
+            {"op": "head", "current_slot": 4, "expect": hx(C)},
+        ],
+    })
+
+
+def main():
+    if os.path.isdir(VECTOR_ROOT):
+        shutil.rmtree(VECTOR_ROOT)
+    gen_bls()
+    gen_consensus()
+    n = sum(len(files) for _, _, files in os.walk(VECTOR_ROOT))
+    print(f"wrote {n} vector files under {VECTOR_ROOT}")
+
+
+if __name__ == "__main__":
+    main()

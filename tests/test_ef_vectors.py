@@ -1,0 +1,43 @@
+"""Conformance-vector harness (testing/ef_tests analog): every handler runs
+every committed vector, and the access tracker asserts no vector file went
+unexercised (check_all_files_accessed.py)."""
+
+import os
+
+import pytest
+
+from lighthouse_tpu.testing.ef_tests import (
+    AccessTracker,
+    VECTOR_ROOT,
+    default_handlers,
+    run_all,
+)
+
+
+@pytest.mark.skipif(not os.path.isdir(VECTOR_ROOT),
+                    reason="vectors not generated")
+def test_all_vectors_pass_and_all_files_accessed():
+    counts = run_all()
+    # Every declared handler found at least one case (an empty handler
+    # means the generator and runner disagree about layout).
+    empty = [k for k, v in counts.items() if v == 0]
+    assert not empty, f"handlers with zero cases: {empty}"
+    assert sum(counts.values()) >= 25
+
+
+@pytest.mark.skipif(not os.path.isdir(VECTOR_ROOT),
+                    reason="vectors not generated")
+def test_unaccessed_file_detected(tmp_path):
+    """The completeness check actually fires: a stray file fails the run."""
+    tracker = AccessTracker(VECTOR_ROOT)
+    for handler in default_handlers():
+        handler.run(tracker)
+    stray = os.path.join(VECTOR_ROOT, "stray.json")
+    with open(stray, "w") as f:
+        f.write("{}")
+    try:
+        with pytest.raises(AssertionError):
+            tracker.assert_all_accessed()
+    finally:
+        os.remove(stray)
+    tracker.assert_all_accessed()

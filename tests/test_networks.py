@@ -1,0 +1,65 @@
+"""Embedded network configs (eth2_network_config/eth2_config analog) and
+the /eth/v1/config API surface."""
+
+import pytest
+
+from lighthouse_tpu.types.networks import (
+    fork_schedule,
+    network_names,
+    spec_for_network,
+)
+
+
+def test_all_networks_resolve():
+    assert set(network_names()) == {
+        "mainnet", "minimal", "sepolia", "holesky", "gnosis", "chiado"
+    }
+    for name in network_names():
+        spec = spec_for_network(name)
+        assert spec.config_name == name
+        # Fork versions must be distinct within a network's schedule.
+        versions = {spec.genesis_fork_version, spec.altair_fork_version,
+                    spec.bellatrix_fork_version, spec.capella_fork_version,
+                    spec.deneb_fork_version}
+        assert len(versions) == 5
+
+
+def test_unknown_network_rejected():
+    with pytest.raises(ValueError):
+        spec_for_network("atlantis")
+
+
+def test_fork_schedule_view_is_ordered():
+    sched = fork_schedule(spec_for_network("mainnet"))
+    assert list(sched) == ["phase0", "altair", "bellatrix", "capella", "deneb"]
+    assert sched["altair"]["previous_version"] == "0x00000000"
+    assert sched["altair"]["current_version"] == "0x01000000"
+    assert sched["capella"]["epoch"] == "194048"
+
+
+def test_network_selected_client_and_config_api():
+    from lighthouse_tpu.client import ClientBuilder, ClientConfig
+    from lighthouse_tpu.http_api import BeaconApiServer
+
+    # A sepolia-config node builds (interop genesis under mainnet preset is
+    # heavy, so keep validators minimal) and serves its config.
+    client = ClientBuilder(ClientConfig(
+        preset="minimal", n_interop_validators=16,
+    )).build()
+    api = BeaconApiServer(client.chain).start()
+    try:
+        import json
+        import urllib.request
+
+        def get(p):
+            with urllib.request.urlopen(api.url + p, timeout=10) as r:
+                return json.loads(r.read())
+
+        spec_out = get("/eth/v1/config/spec")["data"]
+        assert spec_out["CONFIG_NAME"] == "minimal"
+        sched = get("/eth/v1/config/fork_schedule")["data"]
+        assert len(sched) >= 4
+        dep = get("/eth/v1/config/deposit_contract")["data"]
+        assert dep["address"].startswith("0x")
+    finally:
+        api.stop()

@@ -89,9 +89,11 @@ def test_state_advance_precompute():
     chain = h.chain
     head_slot = chain.head.state.slot
     assert chain.advance_head_state_to(head_slot + 1)
-    # The cached snapshot advanced; the canonical head state did not regress.
-    cached = chain.snapshot_cache.get_state_clone(chain.head.block_root)
-    assert cached.slot == head_slot + 1
+    # The advanced variant exists; the exact post-state is untouched.
+    adv = chain.snapshot_cache.get_advanced_clone(chain.head.block_root)
+    assert adv.slot == head_slot + 1
+    exact = chain.snapshot_cache.get_state_clone(chain.head.block_root)
+    assert exact.slot == head_slot
     # Pre-advanced state short-circuits the next import's process_slots and
     # imports still work.
     h.extend_chain(1, attest=False)
@@ -121,3 +123,28 @@ def test_late_block_survives_state_advance():
     chain.process_block(signed)  # must not raise "cannot rewind"
     assert chain.head.block_root == root
     assert chain.head.state.slot == late_slot
+
+
+def test_late_segment_survives_state_advance():
+    """Same guard on the range-sync segment path: a pre-advanced head state
+    must not poison verify_chain_segment for an earlier-slot block."""
+    from lighthouse_tpu.beacon_chain import verify_chain_segment
+    from lighthouse_tpu.testing.harness import BeaconChainHarness
+
+    h = BeaconChainHarness(n_validators=16, bls_backend="fake")
+    h.extend_chain(2, attest=False)
+    chain = h.chain
+
+    h.advance_slot()
+    late_slot = h.current_slot
+    signed, root = h.make_block(slot=late_slot)
+
+    h.advance_slot()
+    assert chain.advance_head_state_to(late_slot + 1)
+    # The exact post-state is still what head queries see.
+    assert chain.head.state.slot < late_slot
+
+    verified = verify_chain_segment(chain, [signed])
+    for sv in verified:
+        chain.process_block_from_segment(sv)
+    assert chain.head.block_root == root
