@@ -1,0 +1,102 @@
+"""Attestation simulator — unattached per-slot attestation scoring.
+
+Reference: `beacon_node/beacon_chain/src/attestation_simulator.rs`: every
+slot the service produces an UNSIGNED attestation at the current head (as a
+validator would at slot+1/3), remembers it, and when the chain advances
+scores it for head/target/source correctness — surfacing, via metrics, what
+rewards a validator attached to this node would be earning, without any
+keys. No signatures: the point is timing/choice quality, not crypto.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, Dict, Optional
+
+from lighthouse_tpu.common.metrics import REGISTRY
+
+SIM_HEAD = REGISTRY.counter(
+    "validator_monitor_attestation_simulator_head_attester_hit_total",
+    "Simulated attestations whose head vote matched the canonical chain",
+)
+SIM_HEAD_MISS = REGISTRY.counter(
+    "validator_monitor_attestation_simulator_head_attester_miss_total",
+    "Simulated attestations whose head vote was dropped/re-orged",
+)
+SIM_TARGET = REGISTRY.counter(
+    "validator_monitor_attestation_simulator_target_attester_hit_total",
+    "Simulated attestations whose target vote matched",
+)
+SIM_TARGET_MISS = REGISTRY.counter(
+    "validator_monitor_attestation_simulator_target_attester_miss_total",
+    "Simulated attestations whose target vote missed",
+)
+
+
+@dataclass
+class _Pending:
+    slot: int
+    head_root: bytes
+    target_epoch: int
+    target_root: bytes
+
+
+class AttestationSimulator:
+    """Produce at each slot; score `lag` slots later against the canonical
+    chain (history lookups via the head state's block_roots vector)."""
+
+    def __init__(self, chain, lag: int = 2, max_pending: int = 64):
+        self.chain = chain
+        self.lag = lag
+        self._pending: Deque[_Pending] = deque(maxlen=max_pending)
+        self.results: Dict[str, int] = {
+            "head_hit": 0, "head_miss": 0, "target_hit": 0, "target_miss": 0,
+        }
+
+    def on_slot(self, slot: int) -> None:
+        """Tick: produce this slot's simulated attestation, then score any
+        pending ones that are now `lag` slots old."""
+        try:
+            data = self.chain.produce_unaggregated_attestation(slot, 0)
+        except Exception:
+            return  # production unavailable (e.g. mid-sync): skip the slot
+        self._pending.append(_Pending(
+            slot=slot,
+            head_root=bytes(data.beacon_block_root),
+            target_epoch=data.target.epoch,
+            target_root=bytes(data.target.root),
+        ))
+        while self._pending and self._pending[0].slot + self.lag <= slot:
+            self._score(self._pending.popleft())
+
+    def _score(self, p: _Pending) -> None:
+        canonical = self._canonical_root_at(p.slot)
+        if canonical is not None and canonical == p.head_root:
+            SIM_HEAD.inc()
+            self.results["head_hit"] += 1
+        else:
+            SIM_HEAD_MISS.inc()
+            self.results["head_miss"] += 1
+        spec = self.chain.spec
+        target_canonical = self._canonical_root_at(
+            spec.start_slot_of_epoch(p.target_epoch)
+        )
+        if target_canonical is not None and target_canonical == p.target_root:
+            SIM_TARGET.inc()
+            self.results["target_hit"] += 1
+        else:
+            SIM_TARGET_MISS.inc()
+            self.results["target_miss"] += 1
+
+    def _canonical_root_at(self, slot: int) -> Optional[bytes]:
+        from lighthouse_tpu.state_transition import helpers as h
+
+        state = self.chain.head.state
+        if slot >= state.slot:
+            # Empty slots at/after the head resolve to the head block — a
+            # correct vote during a chain stall must score as a hit.
+            return self.chain.head.block_root
+        if state.slot - slot >= self.chain.spec.preset.SLOTS_PER_HISTORICAL_ROOT:
+            return None
+        return h.get_block_root_at_slot(state, self.chain.spec, slot)

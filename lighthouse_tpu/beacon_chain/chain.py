@@ -704,7 +704,12 @@ class BeaconChain:
         OUTSIDE the chain lock — the timer must not stall imports. Returns
         True when work ran."""
         root = self.head.block_root
-        state = self.snapshot_cache.get_state_clone(root)
+        # Continue from a previous advance where possible: during a head
+        # stall each tick then costs one slot transition, not a re-run of
+        # the whole gap (and epoch processing never repeats).
+        state = self.snapshot_cache.get_advanced_clone(root)
+        if state is None or state.slot >= slot:
+            state = self.snapshot_cache.get_state_clone(root)
         if state is None:
             with self._lock:
                 state = self.head.state.copy()

@@ -189,6 +189,83 @@ def cmd_db(args) -> int:
     return 0
 
 
+def cmd_new_testnet(args) -> int:
+    """lcli new-testnet: write a testnet directory (config.json +
+    genesis.ssz + boot ENRs file) a node can join via --testnet-dir."""
+    import os
+
+    from lighthouse_tpu.state_transition import genesis as gen
+
+    types, spec = _types_spec(args.preset)
+    os.makedirs(args.output_dir, exist_ok=True)
+    keys = gen.generate_deterministic_keypairs(args.validator_count)
+    state = gen.interop_genesis_state(
+        types, spec, keys, genesis_time=args.genesis_time
+    )
+    fork = spec.fork_name_at_epoch(0)
+    with open(os.path.join(args.output_dir, "genesis.ssz"), "wb") as f:
+        f.write(types.BeaconState[fork].serialize(state))
+    config = {
+        "CONFIG_NAME": f"custom-{args.preset}",
+        "PRESET_BASE": args.preset,
+        "MIN_GENESIS_TIME": args.genesis_time,
+        "MIN_GENESIS_ACTIVE_VALIDATOR_COUNT": args.validator_count,
+        "SECONDS_PER_SLOT": spec.seconds_per_slot,
+        "GENESIS_FORK_VERSION": "0x" + spec.genesis_fork_version.hex(),
+    }
+    with open(os.path.join(args.output_dir, "config.json"), "w") as f:
+        json.dump(config, f, indent=2)
+    with open(os.path.join(args.output_dir, "boot_enr.json"), "w") as f:
+        json.dump(args.boot_nodes or [], f)
+    print(f"testnet dir ready: {args.output_dir}")
+    return 0
+
+
+def cmd_mock_el(args) -> int:
+    """lcli mock-el: stand up the mock execution engine's JSON-RPC server
+    (execution_layer/src/test_utils) for a real BN to talk to."""
+    import time
+
+    from lighthouse_tpu.execution_layer import MockExecutionEngine
+    from lighthouse_tpu.execution_layer.mock import MockEngineServer
+
+    types, _spec = _types_spec(args.preset)
+    tbh = b"\x00" * 32
+    if args.terminal_block_hash:
+        h = args.terminal_block_hash
+        tbh = bytes.fromhex(h[2:] if h.startswith("0x") else h)
+    engine = MockExecutionEngine(types, terminal_block_hash=tbh)
+    server = MockEngineServer(engine, port=args.port).start()
+    print(f"mock execution engine listening on {server.url}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+        return 0
+
+
+def cmd_generate_enr(args) -> int:
+    """lcli ENR tooling: build + print a local ENR record."""
+    from lighthouse_tpu.network.discovery import Enr
+
+    bits = 0
+    for s in (args.attnets or "").split(","):
+        if s:
+            bits |= 1 << int(s)
+    enr = Enr(peer_id=args.peer_id, attnets=bits)
+    print(json.dumps({
+        "peer_id": enr.peer_id,
+        "node_id": "0x" + enr.node_id.hex(),
+        "seq": enr.seq,
+        "attnets": f"0x{enr.attnets:016x}",
+        "subscribed_subnets": [
+            i for i in range(64) if enr.subscribed_to_attnet(i)
+        ],
+    }, indent=2))
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="lighthouse-tpu")
     p.add_argument("--preset", default="minimal",
@@ -244,6 +321,23 @@ def build_parser() -> argparse.ArgumentParser:
     db = sub.add_parser("db", help="inspect a datadir")
     db.add_argument("datadir")
     db.set_defaults(fn=cmd_db)
+
+    nt = sub.add_parser("new-testnet", help="write a testnet directory")
+    nt.add_argument("output_dir")
+    nt.add_argument("--validator-count", type=int, default=64)
+    nt.add_argument("--genesis-time", type=int, default=1_600_000_000)
+    nt.add_argument("--boot-nodes", nargs="*")
+    nt.set_defaults(fn=cmd_new_testnet)
+
+    me = sub.add_parser("mock-el", help="run a mock execution engine")
+    me.add_argument("--port", type=int, default=0)
+    me.add_argument("--terminal-block-hash")
+    me.set_defaults(fn=cmd_mock_el)
+
+    ge = sub.add_parser("generate-enr", help="build + print a local ENR")
+    ge.add_argument("peer_id")
+    ge.add_argument("--attnets", help="comma-separated subnet ids")
+    ge.set_defaults(fn=cmd_generate_enr)
     return p
 
 

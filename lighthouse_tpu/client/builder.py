@@ -58,6 +58,7 @@ class ClientConfig:
     jwt_secret: Optional[bytes] = None
     real_clock: bool = False
     slots_per_restore_point: int = 2048
+    simulate_attestations: bool = False      # attestation_simulator.rs service
 
 
 class Client:
@@ -72,6 +73,13 @@ class Client:
         self.api = api
         self._timer: Optional[threading.Thread] = None
         self._running = False
+        self.attestation_simulator = None
+        if config.simulate_attestations:
+            from lighthouse_tpu.beacon_chain.attestation_simulator import (
+                AttestationSimulator,
+            )
+
+            self.attestation_simulator = AttestationSimulator(chain)
 
     # ------------------------------------------------------------- lifecycle
 
@@ -126,6 +134,8 @@ class Client:
         # EL verdicts applied once the engine responds
         # (otb_verification_service.rs cadence = per-slot).
         self.chain.reverify_optimistic_payloads()
+        if self.attestation_simulator is not None:
+            self.attestation_simulator.on_slot(slot)
         if self.chain.op_pool is not None:
             self.chain.op_pool.prune_attestations(
                 self.chain.spec.epoch_at_slot(slot)

@@ -1,0 +1,186 @@
+"""Slasher database backends.
+
+Reference: the slasher stores its 2D min/max-target chunk arrays and
+attestation records in LMDB or MDBX behind a backend trait
+(slasher/Cargo.toml:7-10, database/interface). Here the seam is
+`SlasherBackend`; the disk backend rides the same native C++ kvstore as the
+hot/cold store, persisting:
+
+  * min/max-target matrices as (validator-chunk, epoch-window) tiles of
+    256 validators x the full history row — the array.rs chunking idea with
+    the epoch axis kept whole (it is bounded by history_length);
+  * attestation records as SSZ under (validator, source, target) keys.
+
+`Slasher.open(backend, types)` restores state; `Slasher.flush()` writes
+dirty validator chunks + new records. Epoch windows prune with the in-memory
+maps.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+_CHUNK_VALIDATORS = 256
+
+_COL_MIN = "smn"
+_COL_MAX = "smx"
+_COL_REC = "src"
+_COL_META = "smt"
+
+
+class SlasherBackend:
+    """Interface (database/interface analog)."""
+
+    def put(self, column: str, key: bytes, value: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, column: str, key: bytes) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def delete(self, column: str, key: bytes) -> None:
+        raise NotImplementedError
+
+    def iter_column(self, column: str) -> Iterator[Tuple[bytes, bytes]]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class MemorySlasherBackend(SlasherBackend):
+    def __init__(self):
+        self._data: Dict[str, Dict[bytes, bytes]] = {}
+
+    def put(self, column, key, value):
+        self._data.setdefault(column, {})[bytes(key)] = bytes(value)
+
+    def get(self, column, key):
+        return self._data.get(column, {}).get(bytes(key))
+
+    def delete(self, column, key):
+        self._data.get(column, {}).pop(bytes(key), None)
+
+    def iter_column(self, column):
+        yield from sorted(self._data.get(column, {}).items())
+
+
+class DiskSlasherBackend(SlasherBackend):
+    """Native C++ kvstore-backed (the LMDB/MDBX slot)."""
+
+    def __init__(self, path: str):
+        from lighthouse_tpu.store.kv import NativeStore
+
+        self._db = NativeStore(path)
+
+    def put(self, column, key, value):
+        self._db.put(column, key, value)
+
+    def get(self, column, key):
+        return self._db.get(column, key)
+
+    def delete(self, column, key):
+        self._db.delete(column, key)
+
+    def iter_column(self, column):
+        yield from self._db.iter_column_from(column)
+
+    def close(self):
+        self._db.close()
+
+
+def _rec_key(v: int, source: int, target: int) -> bytes:
+    return struct.pack(">QQQ", v, source, target)
+
+
+def _unrec_key(k: bytes) -> Tuple[int, int, int]:
+    return struct.unpack(">QQQ", k)
+
+
+class SlasherPersistence:
+    """Glue between a Slasher's in-memory state and a backend."""
+
+    def __init__(self, backend: SlasherBackend, types):
+        self.backend = backend
+        self.types = types
+        self._dirty_chunks: set = set()
+        self._new_records: List[Tuple[int, int, int, object]] = []
+
+    # ---- write side -------------------------------------------------------
+
+    def mark_validator_dirty(self, v: int) -> None:
+        self._dirty_chunks.add(v // _CHUNK_VALIDATORS)
+
+    def record(self, v: int, source: int, target: int, att) -> None:
+        self._new_records.append((v, source, target, att))
+
+    def flush(self, slasher) -> int:
+        """Write dirty tiles + pending records; returns tiles written."""
+        wrote = 0
+        for chunk in sorted(self._dirty_chunks):
+            lo = chunk * _CHUNK_VALIDATORS
+            hi = min(lo + _CHUNK_VALIDATORS, slasher._n)
+            if lo >= hi:
+                continue
+            key = struct.pack(">Q", chunk)
+            self.backend.put(_COL_MIN, key,
+                             slasher._min_target[lo:hi].tobytes())
+            self.backend.put(_COL_MAX, key,
+                             slasher._max_target[lo:hi].tobytes())
+            wrote += 1
+        self._dirty_chunks.clear()
+        for v, s, t, att in self._new_records:
+            self.backend.put(
+                _COL_REC, _rec_key(v, s, t),
+                self.types.IndexedAttestation.serialize(att),
+            )
+        self._new_records.clear()
+        self.backend.put(_COL_META, b"shape", struct.pack(
+            ">QQ", slasher._n, slasher.history
+        ))
+        return wrote
+
+    # ---- read side --------------------------------------------------------
+
+    def restore(self, slasher) -> bool:
+        """Load persisted state into a fresh Slasher; False if none."""
+        meta = self.backend.get(_COL_META, b"shape")
+        if meta is None:
+            return False
+        n, history = struct.unpack(">QQ", meta)
+        if history != slasher.history:
+            raise ValueError(
+                f"persisted history_length {history} != configured "
+                f"{slasher.history} (the reference likewise refuses to "
+                "reuse a DB with a different history_length)"
+            )
+        slasher._grow(n)
+        for key, raw in self.backend.iter_column(_COL_MIN):
+            chunk = struct.unpack(">Q", key)[0]
+            lo = chunk * _CHUNK_VALIDATORS
+            tile = np.frombuffer(raw, dtype=np.uint64).reshape(-1, history)
+            slasher._min_target[lo:lo + tile.shape[0]] = tile
+        for key, raw in self.backend.iter_column(_COL_MAX):
+            chunk = struct.unpack(">Q", key)[0]
+            lo = chunk * _CHUNK_VALIDATORS
+            tile = np.frombuffer(raw, dtype=np.uint64).reshape(-1, history)
+            slasher._max_target[lo:lo + tile.shape[0]] = tile
+        for key, raw in self.backend.iter_column(_COL_REC):
+            v, s, t = _unrec_key(key)
+            att = self.types.IndexedAttestation.deserialize(raw)
+            root = self.types.AttestationData.hash_tree_root(att.data)
+            slasher._by_target[(v, t)] = (root, att)
+            slasher._records[(v, s, t)] = att
+        return True
+
+    def prune(self, low_epoch: int) -> int:
+        """Drop records below the history window (epoch-window pruning)."""
+        drop = [
+            key for key, _ in self.backend.iter_column(_COL_REC)
+            if _unrec_key(key)[2] < low_epoch
+        ]
+        for key in drop:
+            self.backend.delete(_COL_REC, key)
+        return len(drop)

@@ -35,8 +35,10 @@ class AttesterSlashingStatus:
 class Slasher:
     HISTORY_EPOCHS = 4096  # default history_length (slasher config)
 
-    def __init__(self, n_validators: int = 0, history_epochs: int = None):
+    def __init__(self, n_validators: int = 0, history_epochs: int = None,
+                 persistence=None):
         self.history = history_epochs or self.HISTORY_EPOCHS
+        self.persistence = persistence  # SlasherPersistence | None
         self._lock = threading.Lock()
         # min_target[v, s] = min target over recorded attestations of v with
         # source > s;  max_target[v, s] = max target with source < s.
@@ -51,6 +53,26 @@ class Slasher:
         self._records: Dict[Tuple[int, int, int], object] = {}
         if n_validators:
             self._grow(n_validators)
+        if persistence is not None:
+            persistence.restore(self)
+
+    @classmethod
+    def open(cls, path: str, types, n_validators: int = 0,
+             history_epochs: int = None) -> "Slasher":
+        """Disk-backed slasher (the LMDB/MDBX open of the reference)."""
+        from .database import DiskSlasherBackend, SlasherPersistence
+
+        persistence = SlasherPersistence(DiskSlasherBackend(path), types)
+        return cls(n_validators=n_validators, history_epochs=history_epochs,
+                   persistence=persistence)
+
+    def flush(self) -> int:
+        """Persist dirty chunks + new records (batch-commit point of the
+        reference's per-epoch update loop)."""
+        if self.persistence is None:
+            return 0
+        with self._lock:
+            return self.persistence.flush(self)
 
     def _grow(self, n: int) -> None:
         if n <= self._n:
@@ -122,6 +144,9 @@ class Slasher:
                 indexed_attestation) -> None:
         self._by_target[(v, target)] = (data_root, indexed_attestation)
         self._records[(v, source, target)] = indexed_attestation
+        if self.persistence is not None:
+            self.persistence.mark_validator_dirty(v)
+            self.persistence.record(v, source, target, indexed_attestation)
         # Vectorized chunk update (the min/max sweep of MinTargetChunk /
         # MaxTargetChunk::update): epochs BELOW source get min_target
         # candidates; epochs ABOVE source get max_target candidates.
@@ -149,6 +174,8 @@ class Slasher:
             self._records = {
                 k: val for k, val in self._records.items() if k[2] >= low
             }
+            if self.persistence is not None:
+                self.persistence.prune(low)
 
 
 class SlasherService:

@@ -120,11 +120,15 @@ def move_validators(src: KeymanagerClient, dest: KeymanagerClient,
     # includes anything signed between export and delete. Filter it to the
     # moving keys (the full-store dump would seed the destination with
     # unrelated validators' records).
+    def _norm(pk_hex: str) -> str:
+        pk_hex = pk_hex.lower()
+        return pk_hex[2:] if pk_hex.startswith("0x") else pk_hex
+
     interchange = json.loads(deleted["slashing_protection"])
-    wanted = {pk.lower() for pk, _ in moved_keys}
+    wanted = {_norm(pk) for pk, _ in moved_keys}
     interchange["data"] = [
         rec for rec in interchange.get("data", [])
-        if rec.get("pubkey", "").lower() in wanted
+        if _norm(rec.get("pubkey", "")) in wanted
     ]
     dest_out = dest.import_keystores(
         [k for _, k in moved_keys],
