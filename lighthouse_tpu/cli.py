@@ -198,13 +198,16 @@ def cmd_new_testnet(args) -> int:
 
     types, spec = _types_spec(args.preset)
     os.makedirs(args.output_dir, exist_ok=True)
+    from lighthouse_tpu.types.spec import ForkName
+
     keys = gen.generate_deterministic_keypairs(args.validator_count)
     state = gen.interop_genesis_state(
         types, spec, keys, genesis_time=args.genesis_time
     )
-    fork = spec.fork_name_at_epoch(0)
+    # interop_genesis_state builds a capella state regardless of the
+    # preset's mainnet fork schedule — serialize with the matching class.
     with open(os.path.join(args.output_dir, "genesis.ssz"), "wb") as f:
-        f.write(types.BeaconState[fork].serialize(state))
+        f.write(types.BeaconState[ForkName.CAPELLA].serialize(state))
     config = {
         "CONFIG_NAME": f"custom-{args.preset}",
         "PRESET_BASE": args.preset,

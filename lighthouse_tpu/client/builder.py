@@ -110,12 +110,20 @@ class Client:
         clock = self.chain.slot_clock
         last = clock.now_or_genesis()
         advanced_for = -1
+        simulated_for = -1
         while self._running:
             _time.sleep(min(0.05, clock.duration_to_next_slot()))
             now = clock.now_or_genesis()
             if now != last:
                 last = now
                 self.run_slot_tick(now)
+            if now != simulated_for and \
+                    clock.seconds_into_slot() * 3 >= clock.seconds_per_slot:
+                # slot+1/3: where a validator attests — the slot's block has
+                # had its chance to arrive (attestation_simulator cadence).
+                simulated_for = now
+                if self.attestation_simulator is not None:
+                    self.attestation_simulator.on_slot(now)
             if now != advanced_for and \
                     clock.seconds_into_slot() * 4 >= 3 * clock.seconds_per_slot:
                 advanced_for = now
@@ -134,8 +142,6 @@ class Client:
         # EL verdicts applied once the engine responds
         # (otb_verification_service.rs cadence = per-slot).
         self.chain.reverify_optimistic_payloads()
-        if self.attestation_simulator is not None:
-            self.attestation_simulator.on_slot(slot)
         if self.chain.op_pool is not None:
             self.chain.op_pool.prune_attestations(
                 self.chain.spec.epoch_at_slot(slot)

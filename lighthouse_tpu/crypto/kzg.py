@@ -44,6 +44,19 @@ def _root_of_unity(order: int) -> int:
     return pow(_GEN, exp, R)
 
 
+def _batch_inverse(xs: List[int]) -> List[int]:
+    """Montgomery batch inversion: one pow, 3(n-1) muls."""
+    prefix = [1] * (len(xs) + 1)
+    for i, x in enumerate(xs):
+        prefix[i + 1] = prefix[i] * x % R
+    inv_all = pow(prefix[-1], R - 2, R)
+    out = [0] * len(xs)
+    for i in range(len(xs) - 1, -1, -1):
+        out[i] = prefix[i] * inv_all % R
+        inv_all = inv_all * xs[i] % R
+    return out
+
+
 def _bit_reverse(n: int, bits: int) -> int:
     out = 0
     for _ in range(bits):
@@ -118,15 +131,19 @@ class Kzg:
     # ---------------------------------------------------------- evaluation
 
     def evaluate_polynomial(self, evals: Sequence[int], z: int) -> int:
-        """Barycentric evaluation on the bit-reversed domain."""
+        """Barycentric evaluation on the bit-reversed domain. The n per-term
+        denominators invert in ONE modular inversion via Montgomery's batch
+        trick (4096 Fermat inversions would dominate the whole verify)."""
         self._check_len(evals)
         for i, wi in enumerate(self.domain):
             if z == wi:
                 return evals[i]
         zn = (pow(z, self.n, R) - 1) % R
+        denoms = [(z - wi) % R for wi in self.domain]
+        inv_denoms = _batch_inverse(denoms)
         total = 0
-        for ev, wi in zip(evals, self.domain):
-            total = (total + ev * wi % R * pow((z - wi) % R, R - 2, R)) % R
+        for ev, wi, inv_d in zip(evals, self.domain, inv_denoms):
+            total = (total + ev * wi % R * inv_d) % R
         return total * zn % R * pow(self.n, R - 2, R) % R
 
     # --------------------------------------------------------------- proofs
@@ -186,10 +203,12 @@ class Kzg:
 
     def verify_blob_kzg_proof_batch(
         self, blobs: Sequence[bytes], commitments: Sequence[tuple],
-        proofs: Sequence[tuple],
+        proofs: Sequence[tuple], device: bool = False,
     ) -> bool:
         """Random linear combination -> ONE pairing-product check
-        (verify_blob_kzg_proof_batch, crypto/kzg/src/lib.rs:81)."""
+        (verify_blob_kzg_proof_batch, crypto/kzg/src/lib.rs:81). With
+        `device`, the G1 combination + pairing run on the TPU backend
+        (ops/kzg.py), sharing the BLS field kernels."""
         if not (len(blobs) == len(commitments) == len(proofs)):
             raise KzgError("length mismatch")
         if not blobs:
@@ -203,6 +222,12 @@ class Kzg:
             ))
         # Powers of a Fiat-Shamir r weight each equation.
         r = self._batch_challenge(commitments, zs, ys, proofs)
+        if device:
+            from lighthouse_tpu.ops.kzg import verify_kzg_batch_device
+
+            return verify_kzg_batch_device(
+                commitments, zs, ys, proofs, r, self.g2_tau
+            )
         r_pows = [pow(r, i, R) for i in range(len(blobs))]
 
         # sum r^i (C_i - y_i G1 + z_i W_i)  paired with -G2,

@@ -221,6 +221,24 @@ class _Group:
         acc, _ = jax.lax.scan(step, init, positions)
         return acc
 
+    def mul_var_scalar_wide(self, p, k_words, nbits: int = 256):
+        """[k]p with per-element MULTI-WORD scalars (KZG challenges span the
+        full 255-bit Fr). ``k_words``: uint64 words little-endian, shape =
+        batch prefix of ``p`` + (ceil(nbits/64),)."""
+        positions = jnp.arange(nbits - 1, -1, -1, dtype=jnp.uint64)
+
+        def step(acc, pos):
+            acc = self.double(acc)
+            word = jnp.take(k_words, (pos // jnp.uint64(64)).astype(jnp.int32),
+                            axis=-1)
+            bit = (word >> (pos % jnp.uint64(64))) & jnp.uint64(1)
+            with_add = self.add(acc, p)
+            return self.select(bit == 1, with_add, acc), None
+
+        init = jnp.broadcast_to(self.infinity, p.shape)
+        acc, _ = jax.lax.scan(step, init, positions)
+        return acc
+
     def msm_reduce(self, pts, axis_size: int):
         """Sum a batch of points along the leading axis by binary tree
         reduction (log2 depth of complete adds)."""

@@ -1,0 +1,121 @@
+"""Device KZG batch verification — the second TPU workload.
+
+Reference: `crypto/kzg` wraps c-kzg-4844's `verify_blob_kzg_proof_batch`
+(crypto/kzg/src/lib.rs:81), which is already batch-shaped: a random linear
+combination collapses n proofs into ONE pairing-product check. The field
+and curve kernels are shared with the BLS backend (SURVEY.md §2.7 item 2 —
+"shares field arithmetic with the BLS kernels — second TPU target").
+
+Split of labor:
+  * HOST: Fiat–Shamir challenges (SHA-256) and the per-blob barycentric
+    evaluation in Fr (batch-inverted, one modular inversion per blob) —
+    Fr arithmetic is 255-bit scalar work the host does in microseconds.
+  * DEVICE: all G1 curve work — per-proof [z_i]W_i, [y_i]G1, the r^i
+    weighting (full 255-bit scalars via mul_var_scalar_wide), two tree
+    reductions, and the 2-pair product-of-pairings check.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.bls import curves as _oc
+from lighthouse_tpu.crypto.bls.constants import P as _P
+from lighthouse_tpu.crypto.bls.constants import R as _R
+
+from . import curves as cv
+from . import limbs as lb
+from . import pairing as pr
+
+_NEG_G2_AFF = None
+_G1_GEN_PROJ = None
+
+
+def _consts():
+    global _NEG_G2_AFF, _G1_GEN_PROJ
+    if _NEG_G2_AFF is None:
+        gx, gy = _oc.G2_GEN
+        neg = (gx, (_P - gy[0], _P - gy[1]))
+        _NEG_G2_AFF = cv.g2_from_affine([neg])[0]
+        _G1_GEN_PROJ = cv.g1_from_affine([_oc.G1_GEN])[0]
+    return _NEG_G2_AFF, _G1_GEN_PROJ
+
+
+def _scalars_to_words(xs: Sequence[int]) -> np.ndarray:
+    out = np.zeros((len(xs), 4), dtype=np.uint64)
+    for i, x in enumerate(xs):
+        for w in range(4):
+            out[i, w] = (x >> (64 * w)) & 0xFFFFFFFFFFFFFFFF
+    return out
+
+
+def _combine(commit_proj, proof_proj, g2_neg_proj, g2_x_minus, g1_gen_proj,
+             y_words, z_words, r_words):
+    """Device graph: lhs_i = r^i (C_i - [y_i]G1 + [z_i]W_i); reduce; pair.
+
+    The two-pair identity (batch form of verify_kzg_proof):
+        e(sum r^i (C_i - y_i G1 + z_i W_i), -G2) * e(sum r^i W_i, tau G2) == 1
+    """
+    n = commit_proj.shape[0]
+    g1b = jnp.broadcast_to(g1_gen_proj, commit_proj.shape)
+    y_g1 = cv.G1.mul_var_scalar_wide(g1b, y_words)
+    z_w = cv.G1.mul_var_scalar_wide(proof_proj, z_words)
+    term = cv.G1.add(cv.G1.add(commit_proj, cv.G1.neg(y_g1)), z_w)
+    lhs = cv.G1.mul_var_scalar_wide(term, r_words)
+    wr = cv.G1.mul_var_scalar_wide(proof_proj, r_words)
+    lhs_sum = cv.G1.msm_reduce(lhs, n)
+    w_sum = cv.G1.msm_reduce(wr, n)
+
+    p_aff = pr.to_affine_g1(jnp.stack([lhs_sum, w_sum]))
+    q_aff = jnp.stack([g2_neg_proj, g2_x_minus])
+    mask = jnp.ones((2,), dtype=bool)
+    return pr.multi_pairing_is_one(p_aff, q_aff, mask)
+
+
+@lru_cache(maxsize=None)
+def _jitted(n_bucket: int):
+    del n_bucket
+    return jax.jit(_combine)
+
+
+def verify_kzg_batch_device(
+    commitments: Sequence[tuple],
+    zs: Sequence[int],
+    ys: Sequence[int],
+    proofs: Sequence[tuple],
+    r: int,
+    g2_tau_aff,
+) -> bool:
+    """Batched e(C - yG1 + zW, -G2)·e(W, tau G2) check on device. Points are
+    oracle affine tuples; scalars Python ints (Fr)."""
+    n = len(commitments)
+    if n == 0:
+        return True
+    n_bucket = 1
+    while n_bucket < n:
+        n_bucket *= 2
+    neg_g2, g1_gen = _consts()
+
+    pad = n_bucket - n
+    commit_proj = cv.g1_from_affine(list(commitments) + [None] * pad)
+    proof_proj = cv.g1_from_affine(list(proofs) + [None] * pad)
+    r_pows = [pow(r, i, _R) for i in range(n)] + [0] * pad
+    y_words = jnp.asarray(_scalars_to_words(list(ys) + [0] * pad))
+    z_words = jnp.asarray(_scalars_to_words(list(zs) + [0] * pad))
+    r_words = jnp.asarray(_scalars_to_words(r_pows))
+
+    g2_x_aff = cv.g2_from_affine([g2_tau_aff])[0]
+    # tau G2 staged as affine for the pairing (second fixed pair).
+    g2_x = pr.to_affine_g2(g2_x_aff[None])[0]
+    neg_g2_a = pr.to_affine_g2(neg_g2[None])[0]
+
+    core = _jitted(n_bucket)
+    out = core(commit_proj, proof_proj, neg_g2_a, g2_x, g1_gen,
+               y_words, z_words, r_words)
+    return bool(out)

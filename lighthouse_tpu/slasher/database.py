@@ -92,11 +92,15 @@ class DiskSlasherBackend(SlasherBackend):
 
 
 def _rec_key(v: int, source: int, target: int) -> bytes:
-    return struct.pack(">QQQ", v, source, target)
+    # TARGET-first (big-endian): the sorted column iterates in epoch order,
+    # so window pruning is a prefix range scan with early exit — the
+    # reference's epoch-windowed DB layout for exactly this reason.
+    return struct.pack(">QQQ", target, v, source)
 
 
 def _unrec_key(k: bytes) -> Tuple[int, int, int]:
-    return struct.unpack(">QQQ", k)
+    target, v, source = struct.unpack(">QQQ", k)
+    return v, source, target
 
 
 class SlasherPersistence:
@@ -176,11 +180,14 @@ class SlasherPersistence:
         return True
 
     def prune(self, low_epoch: int) -> int:
-        """Drop records below the history window (epoch-window pruning)."""
-        drop = [
-            key for key, _ in self.backend.iter_column(_COL_REC)
-            if _unrec_key(key)[2] < low_epoch
-        ]
+        """Drop records below the history window. Keys sort target-first, so
+        this is a prefix scan that STOPS at the first in-window record —
+        cost proportional to what's pruned, not to the whole column."""
+        drop = []
+        for key, _ in self.backend.iter_column(_COL_REC):
+            if _unrec_key(key)[2] >= low_epoch:
+                break
+            drop.append(key)
         for key in drop:
             self.backend.delete(_COL_REC, key)
         return len(drop)

@@ -174,8 +174,10 @@ class Slasher:
             self._records = {
                 k: val for k, val in self._records.items() if k[2] >= low
             }
-            if self.persistence is not None:
-                self.persistence.prune(low)
+        # Disk pruning runs OUTSIDE the lock: it must not stall the
+        # gossip/import attestation path while the DB churns.
+        if self.persistence is not None:
+            self.persistence.prune(low)
 
 
 class SlasherService:

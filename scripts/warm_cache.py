@@ -39,6 +39,67 @@ def main():
     g.dryrun_multichip(8)
     print(f"sharded dryrun shapes warm ({time.time() - t1:.0f}s)")
 
+    # Unit-test shapes that otherwise compile INSIDE pytest every run.
+    # The pairing-suite pair-batch of 4 has repeatedly segfaulted XLA:CPU
+    # when compiled in a long many-module pytest process; compiled here in
+    # a short process it caches fine and pytest only loads it.
+    t2 = time.time()
+    import jax
+
+    from lighthouse_tpu.crypto.bls import curves as oc
+    from lighthouse_tpu.crypto.bls import hash_to_curve as oh2c
+    from lighthouse_tpu.ops import limbs as lb
+    from lighthouse_tpu.ops import pairing as pr
+
+    sk = 0x1234567890ABCDEF
+    h = oh2c.hash_to_g2(b"\x42" * 32)
+    sig = oc.g2_mul(h, sk)
+    pk = oc.g1_mul(oc.G1_GEN, sk)
+
+    def stage_g1(pts):
+        flat = []
+        for x, y in pts:
+            flat.extend([x, y])
+        return lb.ints_to_mont(flat).reshape(-1, 2, lb.L)
+
+    def stage_g2(pts):
+        flat = []
+        for (x0, x1), (y0, y1) in pts:
+            flat.extend([x0, x1, y0, y1])
+        return lb.ints_to_mont(flat).reshape(-1, 2, 2, lb.L)
+
+    import jax.numpy as jnp
+
+    p4 = stage_g1([pk, oc.g1_neg(oc.G1_GEN), oc.G1_GEN, oc.G1_GEN])
+    q4 = stage_g2([h, sig, oc.G2_GEN, oc.G2_GEN])
+    mask = jnp.asarray([True, True, False, False])
+    assert bool(jax.jit(pr.multi_pairing_is_one)(p4, q4, mask))
+    jax.jit(pr.miller_loop)(p4, q4).block_until_ready()
+    jax.jit(pr.final_exponentiation)(
+        jax.jit(pr.miller_loop)(p4, q4)[0]
+    ).block_until_ready()
+    print(f"pairing-suite shapes warm ({time.time() - t2:.0f}s)")
+
+    # Device KZG batch verify (tests/test_kzg.py + data-availability path).
+    t3 = time.time()
+    from lighthouse_tpu.crypto.bls.constants import R as _R
+    from lighthouse_tpu.crypto.kzg import Kzg
+
+    kzg = Kzg.insecure_dev_setup(16)
+
+    def blob(vals):
+        return b"".join((v % _R).to_bytes(32, "big") for v in vals)
+
+    blobs, cs, ps = [], [], []
+    for i in range(3):
+        b = blob([50 + i + 7 * j for j in range(16)])
+        c = kzg.blob_to_kzg_commitment(b)
+        blobs.append(b)
+        cs.append(c)
+        ps.append(kzg.compute_blob_kzg_proof(b, c))
+    assert kzg.verify_blob_kzg_proof_batch(blobs, cs, ps, device=True)
+    print(f"device-kzg shapes warm ({time.time() - t3:.0f}s)")
+
     # bench shape (64 sets x 4 keys, single device)
     from bench import _make_sets
     from lighthouse_tpu.ops import backend as be

@@ -85,3 +85,23 @@ def test_non_canonical_blob_rejected(kzg):
 
 def test_empty_batch_is_valid(kzg):
     assert kzg.verify_blob_kzg_proof_batch([], [], [])
+
+
+def test_device_batch_verify_matches_oracle(kzg):
+    """ops/kzg.py: the device G1-combination + pairing path agrees with the
+    oracle on valid batches and rejects corrupted ones."""
+    blobs, commitments, proofs = [], [], []
+    for i in range(3):
+        blob = _blob([50 + i + 7 * j for j in range(N)])
+        c = kzg.blob_to_kzg_commitment(blob)
+        blobs.append(blob)
+        commitments.append(c)
+        proofs.append(kzg.compute_blob_kzg_proof(blob, c))
+    assert kzg.verify_blob_kzg_proof_batch(
+        blobs, commitments, proofs, device=True
+    )
+    # Swap two proofs: the batch must fail on device too.
+    bad = [proofs[1], proofs[0], proofs[2]]
+    assert not kzg.verify_blob_kzg_proof_batch(
+        blobs, commitments, bad, device=True
+    )

@@ -184,3 +184,33 @@ def test_slasher_history_length_mismatch_refused(tmp_path):
     s1.persistence.backend.close()
     with pytest.raises(ValueError):
         Slasher.open(path, types, history_epochs=128)
+
+
+def test_disk_prune_is_prefix_ranged(tmp_path):
+    """Record keys sort target-first: pruning removes exactly the
+    out-of-window records and keeps the rest."""
+    from lighthouse_tpu.slasher.slasher import Slasher
+    from lighthouse_tpu.types.containers import minimal_types
+
+    types = minimal_types()
+    s = Slasher.open(str(tmp_path / "p"), types, history_epochs=64)
+
+    def att(source, target, idx):
+        data = types.AttestationData(
+            slot=target * 8, index=0, beacon_block_root=bytes([target]) * 32,
+            source=types.Checkpoint(epoch=source, root=b"\x01" * 32),
+            target=types.Checkpoint(epoch=target, root=bytes([target]) * 32),
+        )
+        return types.IndexedAttestation(
+            attesting_indices=idx, data=data, signature=b"\x00" * 96
+        )
+
+    for t in (3, 10, 80, 90):
+        a = att(t - 1, t, [1])
+        s.process_attestation(a, types.AttestationData.hash_tree_root(a.data))
+    s.flush()
+    n = s.persistence.prune(80)  # window: keep target >= 80
+    assert n == 2  # targets 3, 10 dropped
+    remaining = [k for k, _ in s.persistence.backend.iter_column("src")]
+    assert len(remaining) == 2
+    s.persistence.backend.close()
