@@ -65,12 +65,14 @@ class Client:
     def __init__(self, config: ClientConfig, chain: BeaconChain,
                  processor: BeaconProcessor,
                  network: Optional[NetworkService],
-                 api: Optional[BeaconApiServer]):
+                 api: Optional[BeaconApiServer],
+                 datadir_lock=None):
         self.config = config
         self.chain = chain
         self.processor = processor
         self.network = network
         self.api = api
+        self._datadir_lock = datadir_lock
         self._timer: Optional[threading.Thread] = None
         self._running = False
         self.attestation_simulator = None
@@ -100,6 +102,12 @@ class Client:
         if self._timer:
             self._timer.join(timeout=2)
         self.chain.store.hot.sync()
+        if self._datadir_lock is not None:
+            # The lock outlives the store handle: close BEFORE releasing,
+            # or a second process could open the datadir while this one
+            # still holds writable handles.
+            self.chain.store.close()
+            self._datadir_lock.release()
 
     def _slot_timer(self) -> None:
         """Per-slot tick (timer/): recompute head at the slot boundary,
@@ -160,6 +168,28 @@ class ClientBuilder:
         types = make_types(spec.preset)
 
         # --- store (builder.rs:1030 disk_store) --------------------------
+        lock = None
+        if cfg.datadir:
+            import os
+
+            from lighthouse_tpu.common.lockfile import Lockfile
+
+            os.makedirs(cfg.datadir, exist_ok=True)
+            lock = Lockfile(
+                os.path.join(cfg.datadir, "beacon.lock")
+            ).acquire()
+        try:
+            return self._build_locked(cfg, spec, types, lock, transport,
+                                      peer_id)
+        except BaseException:
+            # A failed build must not leave the datadir locked for the
+            # rest of the process (retries would all fail).
+            if lock is not None:
+                lock.release()
+            raise
+
+    def _build_locked(self, cfg, spec, types, lock, transport,
+                      peer_id: str) -> Client:
         if cfg.datadir:
             store = HotColdDB.open(
                 cfg.datadir, types, spec,
@@ -251,4 +281,5 @@ class ClientBuilder:
         api = None
         if cfg.http_port is not None:
             api = BeaconApiServer(chain, network=network, port=cfg.http_port)
-        return Client(cfg, chain, processor, network, api)
+        return Client(cfg, chain, processor, network, api,
+                      datadir_lock=lock)

@@ -1,0 +1,72 @@
+"""Datadir lockfile (common/lockfile): prevents two processes from opening
+the same beacon/validator datadir — double-running a validator datadir is a
+slashing hazard, so acquisition failure must be loud."""
+
+from __future__ import annotations
+
+import os
+
+
+class LockfileError(Exception):
+    pass
+
+
+class Lockfile:
+    """PID-stamped exclusive lock. Stale locks (dead PID) are reclaimed —
+    the reference behaves the same after a crash."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._held = False
+
+    def acquire(self) -> "Lockfile":
+        try:
+            fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            pid = self._read_pid()
+            if pid is not None and _pid_alive(pid):
+                raise LockfileError(
+                    f"{self.path} is locked by running process {pid} "
+                    "(is another instance using this datadir?)"
+                )
+            # Stale: previous holder is gone; take over atomically-enough
+            # (same-race window as the reference's unlink+create).
+            os.unlink(self.path)
+            fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        with os.fdopen(fd, "w") as f:
+            f.write(str(os.getpid()))
+        self._held = True
+        return self
+
+    def release(self) -> None:
+        if self._held:
+            try:
+                os.unlink(self.path)
+            except FileNotFoundError:
+                pass
+            self._held = False
+
+    def _read_pid(self):
+        try:
+            with open(self.path) as f:
+                return int(f.read().strip() or "0")
+        except (OSError, ValueError):
+            return None
+
+    def __enter__(self) -> "Lockfile":
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def _pid_alive(pid: int) -> bool:
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True

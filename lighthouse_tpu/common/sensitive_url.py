@@ -1,0 +1,36 @@
+"""SensitiveUrl (common/sensitive_url): URLs that carry credentials (JWT
+paths, basic-auth eth1 endpoints, API tokens in query strings) must never
+reach logs verbatim. The full URL stays available for requests; the
+display form is redacted."""
+
+from __future__ import annotations
+
+from urllib.parse import urlparse, urlunparse
+
+
+class SensitiveUrl:
+    def __init__(self, url: str):
+        self.full = url
+        self._parsed = urlparse(url)
+        if not self._parsed.scheme:
+            raise ValueError(f"not a URL: {url!r}")
+
+    @property
+    def redacted(self) -> str:
+        """scheme://host[:port]/ with userinfo, path, and query dropped."""
+        p = self._parsed
+        host = p.hostname or ""
+        netloc = host + (f":{p.port}" if p.port else "")
+        return urlunparse((p.scheme, netloc, "/", "", "", ""))
+
+    def __str__(self) -> str:  # logging uses str(): redact by default
+        return self.redacted
+
+    def __repr__(self) -> str:
+        return f"SensitiveUrl({self.redacted})"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, SensitiveUrl) and other.full == self.full
+
+    def __hash__(self) -> int:
+        return hash(self.full)

@@ -104,6 +104,7 @@ def test_resume_from_store(tmp_path, finalized_donor):
     client = ClientBuilder(cfg).build()
     head_root = client.chain.head.block_root
     head_slot = client.chain.head.state.slot
+    client.stop()
     client.chain.store.close()
 
     resumed = ClientBuilder(ClientConfig(
@@ -114,4 +115,5 @@ def test_resume_from_store(tmp_path, finalized_donor):
     # The original backfill frontier survived the restart.
     anchor = resumed.chain.store.get_anchor_info()
     assert anchor is not None and anchor.oldest_block_slot == head_slot
+    resumed.stop()
     resumed.chain.store.close()

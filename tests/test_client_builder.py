@@ -23,11 +23,22 @@ def test_build_disk_node_and_genesis_persisted(tmp_path):
     client = ClientBuilder(cfg).build()
     root = client.chain.store.get_genesis_block_root()
     assert root is not None
+
+    # The datadir is locked while the client holds it (common/lockfile).
+    import pytest as _pytest
+
+    from lighthouse_tpu.common.lockfile import LockfileError
+
+    with _pytest.raises(LockfileError):
+        ClientBuilder(cfg).build()
+
+    client.stop()
     client.chain.store.close()
 
-    # reopen: genesis is still there (FromStore resume seam)
+    # reopen after clean shutdown: genesis is still there (FromStore seam)
     client2 = ClientBuilder(cfg).build()
     assert client2.chain.store.get_genesis_block_root() == root
+    client2.stop()
     client2.chain.store.close()
 
 
