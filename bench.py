@@ -20,9 +20,14 @@ import time
 
 BLST_CPU_BASELINE_SIGS_PER_SEC = 20_000.0
 
-# Batch shape: 64 sets (the reference's gossip batch cap,
-# beacon_processor/src/lib.rs:215-216) x 4 aggregated pubkeys per set.
-N_SETS = 64
+# Batch shape: 256 sets x 4 aggregated pubkeys. The reference caps GOSSIP
+# batches at 64 (beacon_processor/src/lib.rs:215-216) because CPU batches
+# amortize poorly against poisoning risk; the BASELINE.json eval configs
+# measure 1k/10k/100k-set batches (chain-segment replay + op-pool shapes),
+# and on TPU throughput scales with batch (18 sigs/s @64 -> 62 @256,
+# NOTES_TPU_PERF.md). 256 is the largest shape whose compiled executable
+# fits the axon tunnel's 2 GiB serialization cap this round.
+N_SETS = 256
 KEYS_PER_SET = 4
 TIMED_ITERS = 3
 

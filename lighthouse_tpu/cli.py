@@ -175,7 +175,7 @@ def cmd_db(args) -> int:
     from lighthouse_tpu.store.kv import DBColumn
 
     types, spec = _types_spec(args.preset)
-    db = HotColdDB.open(args.datadir, types, spec)
+    db, lock = _open_locked_db(args.datadir, types, spec)
     counts = {}
     for col in ("blk", "ste", "bss", "bma"):
         counts[col] = sum(1 for _ in db.hot.iter_column_from(col))
@@ -186,6 +186,55 @@ def cmd_db(args) -> int:
     }
     print(json.dumps(info, indent=2))
     db.close()
+    lock.release()
+    return 0
+
+
+def _open_locked_db(datadir: str, types, spec):
+    """CLI datadir access honors the same beacon.lock as the node — running
+    db tools against a live node's datadir would corrupt it."""
+    import os
+
+    from lighthouse_tpu.common.lockfile import Lockfile
+    from lighthouse_tpu.store import HotColdDB
+
+    lock = Lockfile(os.path.join(datadir, "beacon.lock")).acquire()
+    return HotColdDB.open(datadir, types, spec), lock
+
+
+def cmd_db_prune(args) -> int:
+    """database_manager prune: compact the hot DB (dead WAL/table space
+    after finalization migrations)."""
+    types, spec = _types_spec(args.preset)
+    db, lock = _open_locked_db(args.datadir, types, spec)
+    try:
+        db.hot.compact()
+        db.cold.compact()
+        print("compacted hot+cold")
+    finally:
+        db.close()
+        lock.release()
+    return 0
+
+
+def cmd_db_reconstruct(args) -> int:
+    """database_manager reconstruct: rebuild a historic state from the
+    freezer's restore points (store/src/reconstruct.rs seam)."""
+    types, spec = _types_spec(args.preset)
+    db, lock = _open_locked_db(args.datadir, types, spec)
+    try:
+        state = db.load_cold_state_by_slot(args.slot)
+        if state is None:
+            print(f"no cold state reachable for slot {args.slot}")
+            return 1
+        fork = spec.fork_name_at_epoch(spec.epoch_at_slot(state.slot))
+        data = types.BeaconState[fork].serialize(state)
+        with open(args.output, "wb") as f:
+            f.write(data)
+        print(f"reconstructed state at slot {state.slot}: {len(data)} bytes")
+    finally:
+        db.close()
+        lock.release()
     return 0
 
 
@@ -324,6 +373,17 @@ def build_parser() -> argparse.ArgumentParser:
     db = sub.add_parser("db", help="inspect a datadir")
     db.add_argument("datadir")
     db.set_defaults(fn=cmd_db)
+
+    dbp = sub.add_parser("db-prune", help="compact a datadir's stores")
+    dbp.add_argument("datadir")
+    dbp.set_defaults(fn=cmd_db_prune)
+
+    dbr = sub.add_parser("db-reconstruct",
+                         help="rebuild a historic state from the freezer")
+    dbr.add_argument("datadir")
+    dbr.add_argument("slot", type=int)
+    dbr.add_argument("output")
+    dbr.set_defaults(fn=cmd_db_reconstruct)
 
     nt = sub.add_parser("new-testnet", help="write a testnet directory")
     nt.add_argument("output_dir")

@@ -95,6 +95,9 @@ class Client:
         return self
 
     def stop(self) -> None:
+        if getattr(self, "_stopped", False):
+            return  # idempotent: the store closes once
+        self._stopped = True
         self._running = False
         self.processor.stop()
         if self.api is not None:

@@ -20,21 +20,30 @@ class Lockfile:
         self._held = False
 
     def acquire(self) -> "Lockfile":
-        try:
-            fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-        except FileExistsError:
-            pid = self._read_pid()
-            if pid is not None and _pid_alive(pid):
-                raise LockfileError(
-                    f"{self.path} is locked by running process {pid} "
-                    "(is another instance using this datadir?)"
-                )
-            # Stale: previous holder is gone; take over atomically-enough
-            # (same-race window as the reference's unlink+create).
-            os.unlink(self.path)
-            fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-        with os.fdopen(fd, "w") as f:
+        """The lock appears ATOMICALLY with its pid already inside (temp
+        file + os.link), so a concurrent starter can never observe an
+        empty/partial lockfile and mistake a live holder for stale."""
+        tmp = f"{self.path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
             f.write(str(os.getpid()))
+        try:
+            try:
+                os.link(tmp, self.path)
+            except FileExistsError:
+                pid = self._read_pid()
+                if pid is None or _pid_alive(pid):
+                    # Unreadable/garbage pid counts as HELD: failing loud
+                    # beats stealing a live holder's datadir.
+                    raise LockfileError(
+                        f"{self.path} is locked"
+                        + (f" by running process {pid}" if pid else "")
+                        + " (is another instance using this datadir?)"
+                    )
+                # Stale: previous holder is dead; take over.
+                os.unlink(self.path)
+                os.link(tmp, self.path)
+        finally:
+            os.unlink(tmp)
         self._held = True
         return self
 
@@ -47,9 +56,12 @@ class Lockfile:
             self._held = False
 
     def _read_pid(self):
+        """Holder's pid, or None when unreadable/garbage (treated as HELD
+        by acquire — never as stale)."""
         try:
             with open(self.path) as f:
-                return int(f.read().strip() or "0")
+                raw = f.read().strip()
+            return int(raw) if raw else None
         except (OSError, ValueError):
             return None
 

@@ -4,17 +4,21 @@ protocol + beacon_chain light-client server paths)."""
 from .light_client import (
     LightClientBootstrap,
     LightClientError,
+    LightClientFinalityUpdate,
     LightClientStore,
     LightClientUpdate,
     create_bootstrap,
+    create_finality_update,
     create_optimistic_update,
 )
 
 __all__ = [
     "LightClientBootstrap",
     "LightClientError",
+    "LightClientFinalityUpdate",
     "LightClientStore",
     "LightClientUpdate",
     "create_bootstrap",
+    "create_finality_update",
     "create_optimistic_update",
 ]

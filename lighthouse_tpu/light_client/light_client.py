@@ -45,6 +45,22 @@ class LightClientUpdate:
     signature_slot: int
 
 
+@dataclass
+class LightClientFinalityUpdate:
+    """Finality update (LightClientFinalityUpdate): the attested header's
+    state proves a finalized checkpoint; the matching finalized header rides
+    along. The proof pins the state's `finalized_checkpoint` field (the
+    client re-derives the field index, as with the bootstrap proof)."""
+
+    attested_header: object
+    finalized_header: object
+    finalized_epoch: int
+    finality_proof_index: int
+    finality_branch: List[bytes]
+    sync_aggregate: object
+    signature_slot: int
+
+
 # ---------------------------------------------------------------- server
 
 
@@ -59,12 +75,21 @@ def _header_of_block(types, signed_block):
     )
 
 
+def _state_of_block(chain, signed):
+    """Post-state of a block: hot store first, then the freezer's restore
+    points (finalized-era bootstraps are served from cold history)."""
+    state = chain.store.get_state(bytes(signed.message.state_root))
+    if state is None:
+        state = chain.store.load_cold_state_by_slot(signed.message.slot)
+    return state
+
+
 def create_bootstrap(chain, block_root: bytes) -> LightClientBootstrap:
     """Bootstrap anchored at `block_root` (must be in the store)."""
     signed = chain.store.get_block(block_root)
     if signed is None:
         raise LightClientError("unknown block")
-    state = chain.store.get_state(bytes(signed.message.state_root))
+    state = _state_of_block(chain, signed)
     if state is None:
         raise LightClientError("state unavailable")
     fork = chain.fork_at(signed.message.slot)
@@ -90,6 +115,39 @@ def create_optimistic_update(chain, block_root: bytes) -> LightClientUpdate:
         raise LightClientError("parent unavailable")
     return LightClientUpdate(
         attested_header=_header_of_block(chain.types, parent),
+        sync_aggregate=signed.message.body.sync_aggregate,
+        signature_slot=signed.message.slot,
+    )
+
+
+def create_finality_update(chain, block_root: bytes) -> LightClientFinalityUpdate:
+    """Finality update derived from `block_root`'s sync aggregate: the
+    aggregate signs the PARENT (attested) header, whose post-state proves
+    the finalized checkpoint (light-client server finality_update path)."""
+    signed = chain.store.get_block(block_root)
+    if signed is None:
+        raise LightClientError("unknown block")
+    parent = chain.store.get_block(bytes(signed.message.parent_root))
+    if parent is None:
+        raise LightClientError("parent unavailable")
+    attested_state = _state_of_block(chain, parent)
+    if attested_state is None:
+        raise LightClientError("attested state unavailable")
+    fc = attested_state.finalized_checkpoint
+    finalized = chain.store.get_block(bytes(fc.root))
+    if finalized is None:
+        raise LightClientError("finalized block unavailable")
+    fork = chain.fork_at(parent.message.slot)
+    cls = chain.types.BeaconState[fork]
+    index, _leaf, branch = ssz.container_field_proof(
+        cls, attested_state, "finalized_checkpoint"
+    )
+    return LightClientFinalityUpdate(
+        attested_header=_header_of_block(chain.types, parent),
+        finalized_header=_header_of_block(chain.types, finalized),
+        finalized_epoch=int(fc.epoch),
+        finality_proof_index=index,
+        finality_branch=branch,
         sync_aggregate=signed.message.body.sync_aggregate,
         signature_slot=signed.message.slot,
     )
@@ -137,24 +195,25 @@ class LightClientStore:
         self.optimistic_header = bootstrap.header
         self.current_sync_committee = bootstrap.current_sync_committee
 
-    def process_optimistic_update(self, update: LightClientUpdate) -> None:
+    def _verify_sync_aggregate(self, attested_header, sync_aggregate,
+                               signature_slot: int) -> None:
         if self.current_sync_committee is None:
             raise LightClientError("not bootstrapped")
         t, spec = self.types, self.spec
-        bits = list(update.sync_aggregate.sync_committee_bits)
+        bits = list(sync_aggregate.sync_committee_bits)
         participation = sum(1 for b in bits if b)
         if participation * 3 < len(bits) * 2:
             raise LightClientError(
                 f"insufficient participation {participation}/{len(bits)}"
             )
         # signature over the attested header root at epoch(signature_slot-1)
-        prev_slot = max(update.signature_slot, 1) - 1
+        prev_slot = max(signature_slot, 1) - 1
         domain = get_domain(
             spec, DOMAIN_SYNC_COMMITTEE, spec.epoch_at_slot(prev_slot),
             self.fork_version, self.fork_version, 0,
             self.genesis_validators_root,
         )
-        root = t.BeaconBlockHeader.hash_tree_root(update.attested_header)
+        root = t.BeaconBlockHeader.hash_tree_root(attested_header)
         signing_root = compute_signing_root(root, ssz.Bytes32, domain)
         pubkeys = [
             bls.PublicKey.from_bytes(bytes(pk))
@@ -163,10 +222,48 @@ class LightClientStore:
             ) if bit
         ]
         sig = bls.Signature.from_bytes(
-            bytes(update.sync_aggregate.sync_committee_signature)
+            bytes(sync_aggregate.sync_committee_signature)
         )
         if not bls.fast_aggregate_verify(pubkeys, signing_root, sig):
             raise LightClientError("sync aggregate signature invalid")
+
+    def process_optimistic_update(self, update: LightClientUpdate) -> None:
+        self._verify_sync_aggregate(
+            update.attested_header, update.sync_aggregate,
+            update.signature_slot,
+        )
+        if self.optimistic_header is None or \
+                update.attested_header.slot > self.optimistic_header.slot:
+            self.optimistic_header = update.attested_header
+
+    def process_finality_update(self, update: LightClientFinalityUpdate) -> None:
+        """Advance the FINALIZED header: committee-signed attested header
+        whose state proves the finalized checkpoint, which must commit to
+        the supplied finalized header."""
+        self._verify_sync_aggregate(
+            update.attested_header, update.sync_aggregate,
+            update.signature_slot,
+        )
+        t = self.types
+        state_cls = t.BeaconState[self.fork]
+        expected_index = [f for f, _ in state_cls._ssz_fields].index(
+            "finalized_checkpoint"
+        )
+        if update.finality_proof_index != expected_index:
+            raise LightClientError("finality proof index mismatch")
+        fin_root = t.BeaconBlockHeader.hash_tree_root(update.finalized_header)
+        leaf = t.Checkpoint.hash_tree_root(t.Checkpoint(
+            epoch=update.finalized_epoch, root=fin_root
+        ))
+        ok = ssz.verify_field_proof(
+            bytes(update.attested_header.state_root), leaf,
+            update.finality_branch, update.finality_proof_index,
+        )
+        if not ok:
+            raise LightClientError("finality proof invalid")
+        if self.finalized_header is None or \
+                update.finalized_header.slot > self.finalized_header.slot:
+            self.finalized_header = update.finalized_header
         if self.optimistic_header is None or \
                 update.attested_header.slot > self.optimistic_header.slot:
             self.optimistic_header = update.attested_header

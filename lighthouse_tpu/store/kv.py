@@ -249,6 +249,8 @@ class NativeStore(KeyValueStore):
             self._lib.kv_iter_free(it)
 
     def sync(self) -> None:
+        if self._closed:
+            return  # post-close sync is a no-op, not a use-after-free
         if self._lib.kv_sync(self._db) != 0:
             raise StoreError("sync failed")
 

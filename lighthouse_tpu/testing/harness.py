@@ -25,6 +25,7 @@ from lighthouse_tpu.types.spec import (
     DOMAIN_BEACON_PROPOSER,
     DOMAIN_RANDAO,
     DOMAIN_SELECTION_PROOF,
+    DOMAIN_SYNC_COMMITTEE,
     compute_signing_root,
     get_domain,
     minimal_spec,
@@ -57,6 +58,10 @@ class BeaconChainHarness:
             execution_layer=execution_layer,
             op_pool=op_pool,
         )
+        # Full sync-aggregate participation in produced blocks (the
+        # reference harness signs sync contributions too). Off by default:
+        # each block costs SYNC_COMMITTEE_SIZE extra signatures.
+        self.include_sync_aggregates = False
 
     # ------------------------------------------------------------------ time
 
@@ -140,15 +145,19 @@ class BeaconChainHarness:
                 ).digest(),
                 withdrawals=bp.get_expected_withdrawals(state, types, spec),
             )
+        if self.include_sync_aggregates:
+            sync_aggregate = self.make_sync_aggregate(state, parent_root, slot)
+        else:
+            sync_aggregate = types.SyncAggregate(
+                sync_committee_bits=[False] * spec.preset.SYNC_COMMITTEE_SIZE,
+                sync_committee_signature=bls.Signature.infinity().to_bytes(),
+            )
         body = types.BeaconBlockBodyCapella(
             randao_reveal=self.randao_reveal(state, epoch, proposer),
             eth1_data=state.eth1_data,
             graffiti=b"\x00" * 32,
             attestations=list(attestations),
-            sync_aggregate=types.SyncAggregate(
-                sync_committee_bits=[False] * spec.preset.SYNC_COMMITTEE_SIZE,
-                sync_committee_signature=bls.Signature.infinity().to_bytes(),
-            ),
+            sync_aggregate=sync_aggregate,
             execution_payload=payload,
         )
         block = types.BeaconBlock[fork](
@@ -169,6 +178,39 @@ class BeaconChainHarness:
         signed = self.sign_block(state, block, fork)
         root = types.BeaconBlock[fork].hash_tree_root(block)
         return signed, root
+
+    def make_sync_aggregate(self, state, parent_root: bytes, slot: int):
+        """Full-participation SyncAggregate over `parent_root`, signed by
+        every current-sync-committee member whose key we hold (the spec:
+        messages sign the previous slot's block root under
+        DOMAIN_SYNC_COMMITTEE at epoch(slot-1))."""
+        types, spec = self.types, self.spec
+        prev_slot = max(slot, 1) - 1
+        domain = self._domain(
+            state, DOMAIN_SYNC_COMMITTEE, spec.epoch_at_slot(prev_slot)
+        )
+        root = compute_signing_root(parent_root, ssz.Bytes32, domain)
+        if not hasattr(self, "_sk_by_pubkey"):
+            # keys are fixed at construction: derive the map once, not per
+            # block (n pubkey derivations each otherwise).
+            self._sk_by_pubkey = {
+                sk.public_key().to_bytes(): sk for sk in self.keys
+            }
+        by_pubkey = self._sk_by_pubkey
+        bits, sigs = [], []
+        for pk in state.current_sync_committee.pubkeys:
+            sk = by_pubkey.get(bytes(pk))
+            if sk is None:
+                bits.append(False)
+                continue
+            bits.append(True)
+            sigs.append(sk.sign(root))
+        signature = bls.AggregateSignature.aggregate(sigs) if sigs else \
+            bls.AggregateSignature.infinity()
+        return types.SyncAggregate(
+            sync_committee_bits=bits,
+            sync_committee_signature=signature.to_bytes(),
+        )
 
     def make_attestations(
         self, slot: Optional[int] = None, head_root: Optional[bytes] = None

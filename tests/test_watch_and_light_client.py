@@ -128,3 +128,67 @@ def test_watch_updater_ingests_chain(rig):
     assert sum(counts.values()) == stats["blocks"]
     # updater is incremental
     assert updater.update() == 0
+
+
+def test_light_client_finality_update():
+    """Finality updates: committee-signed attested header + Merkle-proved
+    finalized checkpoint advance the client's FINALIZED header."""
+    from lighthouse_tpu.light_client import (
+        create_bootstrap,
+        create_finality_update,
+    )
+
+    from lighthouse_tpu.store import HotColdDB, StoreConfig
+    from lighthouse_tpu.types.containers import make_types
+    from lighthouse_tpu.types.spec import minimal_spec
+
+    spec = minimal_spec()
+    # Dense restore points: finalized-era anchor states serve from cold.
+    store_db = HotColdDB(make_types(spec.preset), spec,
+                         config=StoreConfig(slots_per_restore_point=8))
+    h = BeaconChainHarness(n_validators=32, bls_backend="fake",
+                           store=store_db)
+    h.include_sync_aggregates = True
+    per_epoch = h.spec.preset.SLOTS_PER_EPOCH
+    # One block past the epoch boundary: its sync aggregate attests the
+    # boundary state, which is where the state's finalized checkpoint moves.
+    h.extend_chain(4 * per_epoch + 1, attest=True)
+    chain = h.chain
+    assert chain.fork_choice.finalized.epoch >= 1
+    assert chain.head.state.finalized_checkpoint.epoch >= 1
+
+    roots = list(chain.store.iter_block_roots_back(chain.head.block_root))
+    # Anchor EARLY (near genesis): the finality update must then advance the
+    # finalized header forward to the chain's finalized checkpoint.
+    anchor_root, anchor_slot = roots[-2]
+    store = LightClientStore(
+        h.types, h.spec,
+        trusted_block_root=anchor_root,
+        genesis_validators_root=bytes(
+            chain.head.state.genesis_validators_root
+        ),
+        fork_version=h.spec.fork_version_for_name("capella"),
+    )
+    store.process_bootstrap(create_bootstrap(chain, anchor_root))
+    assert store.finalized_header.slot == anchor_slot
+
+    update = create_finality_update(chain, roots[0][0])
+    store.process_finality_update(update)
+    # Finalized header jumped to the ATTESTED state's finalized checkpoint
+    # (fork choice may already be a step ahead via unrealized finality).
+    attested_block = chain.store.get_block(roots[1][0])
+    attested_state = chain.store.get_state(
+        bytes(attested_block.message.state_root)
+    )
+    assert h.types.BeaconBlockHeader.hash_tree_root(
+        store.finalized_header
+    ) == bytes(attested_state.finalized_checkpoint.root)
+    assert store.finalized_header.slot > anchor_slot  # moved forward
+    # Optimistic header advanced to the attested header too.
+    assert store.optimistic_header.slot == roots[1][1]
+
+    # Tampered finalized header: proof must fail.
+    bad = create_finality_update(chain, roots[0][0])
+    bad.finalized_header.proposer_index += 1
+    with pytest.raises(LightClientError):
+        store.process_finality_update(bad)
