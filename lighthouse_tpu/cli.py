@@ -176,17 +176,19 @@ def cmd_db(args) -> int:
 
     types, spec = _types_spec(args.preset)
     db, lock = _open_locked_db(args.datadir, types, spec)
-    counts = {}
-    for col in ("blk", "ste", "bss", "bma"):
-        counts[col] = sum(1 for _ in db.hot.iter_column_from(col))
-    info = {
-        "split_slot": db.split.slot,
-        "hot_counts": counts,
-        "anchor": bool(db.get_anchor_info()),
-    }
-    print(json.dumps(info, indent=2))
-    db.close()
-    lock.release()
+    try:
+        counts = {}
+        for col in ("blk", "ste", "bss", "bma"):
+            counts[col] = sum(1 for _ in db.hot.iter_column_from(col))
+        info = {
+            "split_slot": db.split.slot,
+            "hot_counts": counts,
+            "anchor": bool(db.get_anchor_info()),
+        }
+        print(json.dumps(info, indent=2))
+    finally:
+        db.close()
+        lock.release()
     return 0
 
 

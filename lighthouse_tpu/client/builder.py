@@ -86,6 +86,7 @@ class Client:
     # ------------------------------------------------------------- lifecycle
 
     def start(self) -> "Client":
+        self._stopped = False
         self.processor.start()
         if self.api is not None:
             self.api.start()

@@ -4,6 +4,7 @@ slashing hazard, so acquisition failure must be loud."""
 
 from __future__ import annotations
 
+import fcntl
 import os
 
 
@@ -12,55 +13,52 @@ class LockfileError(Exception):
 
 
 class Lockfile:
-    """PID-stamped exclusive lock. Stale locks (dead PID) are reclaimed —
-    the reference behaves the same after a crash."""
+    """flock-based exclusive lock. The kernel arbitrates acquisition
+    atomically and drops the lock when the holder dies, so there is no
+    stale-file takeover path to race on; the pid inside the file is purely
+    diagnostic."""
 
     def __init__(self, path: str):
         self.path = path
-        self._held = False
+        self._fd = None
 
     def acquire(self) -> "Lockfile":
-        """The lock appears ATOMICALLY with its pid already inside (temp
-        file + os.link), so a concurrent starter can never observe an
-        empty/partial lockfile and mistake a live holder for stale."""
-        tmp = f"{self.path}.{os.getpid()}.tmp"
-        with open(tmp, "w") as f:
-            f.write(str(os.getpid()))
+        fd = os.open(self.path, os.O_CREAT | os.O_RDWR)
         try:
-            try:
-                os.link(tmp, self.path)
-            except FileExistsError:
-                pid = self._read_pid()
-                if pid is None or _pid_alive(pid):
-                    # Unreadable/garbage pid counts as HELD: failing loud
-                    # beats stealing a live holder's datadir.
-                    raise LockfileError(
-                        f"{self.path} is locked"
-                        + (f" by running process {pid}" if pid else "")
-                        + " (is another instance using this datadir?)"
-                    )
-                # Stale: previous holder is dead; take over.
-                os.unlink(self.path)
-                os.link(tmp, self.path)
-        finally:
-            os.unlink(tmp)
-        self._held = True
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except BlockingIOError:
+            pid = self._read_pid(fd)
+            os.close(fd)
+            raise LockfileError(
+                f"{self.path} is locked"
+                + (f" by running process {pid}" if pid else "")
+                + " (is another instance using this datadir?)"
+            )
+        os.ftruncate(fd, 0)
+        os.write(fd, str(os.getpid()).encode())
+        os.fsync(fd)
+        self._fd = fd
         return self
 
     def release(self) -> None:
-        if self._held:
+        if self._fd is not None:
             try:
                 os.unlink(self.path)
             except FileNotFoundError:
                 pass
-            self._held = False
+            fcntl.flock(self._fd, fcntl.LOCK_UN)
+            os.close(self._fd)
+            self._fd = None
 
-    def _read_pid(self):
-        """Holder's pid, or None when unreadable/garbage (treated as HELD
-        by acquire — never as stale)."""
+    @property
+    def _held(self) -> bool:
+        return self._fd is not None
+
+    @staticmethod
+    def _read_pid(fd: int):
         try:
-            with open(self.path) as f:
-                raw = f.read().strip()
+            os.lseek(fd, 0, os.SEEK_SET)
+            raw = os.read(fd, 32).decode().strip()
             return int(raw) if raw else None
         except (OSError, ValueError):
             return None
@@ -70,15 +68,3 @@ class Lockfile:
 
     def __exit__(self, *exc) -> None:
         self.release()
-
-
-def _pid_alive(pid: int) -> bool:
-    if pid <= 0:
-        return False
-    try:
-        os.kill(pid, 0)
-        return True
-    except ProcessLookupError:
-        return False
-    except PermissionError:
-        return True

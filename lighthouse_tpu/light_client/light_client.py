@@ -153,6 +153,12 @@ def create_finality_update(chain, block_root: bytes) -> LightClientFinalityUpdat
     )
 
 
+def _expected_field_index(state_cls, field: str) -> int:
+    """Client-side pin of a proved state field's index — never trust a
+    server-supplied index (it could prove an attacker-chosen field)."""
+    return [f for f, _ in state_cls._ssz_fields].index(field)
+
+
 # ---------------------------------------------------------------- client
 
 
@@ -178,9 +184,8 @@ class LightClientStore:
         # The field index is a CLIENT-side constant (the spec's
         # CURRENT_SYNC_COMMITTEE_INDEX): a server-supplied index could prove
         # a different (attacker-chosen) committee field instead.
-        state_cls = t.BeaconState[self.fork]
-        expected_index = [f for f, _ in state_cls._ssz_fields].index(
-            "current_sync_committee"
+        expected_index = _expected_field_index(
+            t.BeaconState[self.fork], "current_sync_committee"
         )
         if bootstrap.proof_index != expected_index:
             raise LightClientError("bootstrap proof index mismatch")
@@ -245,9 +250,8 @@ class LightClientStore:
             update.signature_slot,
         )
         t = self.types
-        state_cls = t.BeaconState[self.fork]
-        expected_index = [f for f, _ in state_cls._ssz_fields].index(
-            "finalized_checkpoint"
+        expected_index = _expected_field_index(
+            t.BeaconState[self.fork], "finalized_checkpoint"
         )
         if update.finality_proof_index != expected_index:
             raise LightClientError("finality proof index mismatch")

@@ -341,6 +341,15 @@ def _apply_operation(name: str, state, types, spec, fork, op_bytes):
     elif name == "attester_slashing":
         op = types.AttesterSlashing.deserialize(op_bytes)
         bp.process_attester_slashing(state, types, spec, op, fork, vs, pk)
+    elif name == "deposit":
+        op = types.Deposit.deserialize(op_bytes)
+        bp.process_deposit(state, types, spec, op, fork)
+    elif name == "bls_to_execution_change":
+        op = types.SignedBLSToExecutionChange.deserialize(op_bytes)
+        bp.process_bls_to_execution_change(state, types, spec, op, vs)
+    elif name == "sync_aggregate":
+        op = types.SyncAggregate.deserialize(op_bytes)
+        bp.process_sync_aggregate(state, types, spec, op, vs, pk)
     else:
         raise ValueError(f"unknown operation {name}")
 
@@ -410,6 +419,37 @@ class EpochProcessingHandler(Handler):
 # ---------------------------------------------------------------------------
 
 
+class TransitionHandler(Handler):
+    """Cross-fork transition (transition.rs): a pre-state carried through a
+    fork boundary; the fork activation epoch comes from meta (the vectors
+    use a custom schedule, since the committed configs activate at 0)."""
+
+    runner, name = "transition", "core"
+
+    def run_case(self, case_dir, tracker):
+        import dataclasses
+
+        from lighthouse_tpu.state_transition import slot_processing as sp
+
+        meta = tracker.read_json(os.path.join(case_dir, "meta.json"))
+        ctx = self.context(case_dir, tracker)
+        types, base_spec = _types_and_spec(ctx["config"])
+        spec = dataclasses.replace(
+            base_spec, **{f"{meta['fork']}_fork_epoch": meta["fork_epoch"]}
+        )
+        pre_cls = types.BeaconState[meta["pre_fork"]]
+        post_cls = types.BeaconState[meta["fork"]]
+        state = pre_cls.deserialize(
+            tracker.read(os.path.join(case_dir, "pre.ssz"))
+        )
+        state = sp.process_slots(state, types, spec, meta["to_slot"])
+        assert post_cls.serialize(state) == tracker.read(
+            os.path.join(case_dir, "post.ssz")
+        ), "post-fork state mismatch"
+        assert bytes(state.fork.current_version) == \
+            spec.fork_version_for_name(meta["fork"]), "fork version not set"
+
+
 class ForkChoiceHandler(Handler):
     runner, name = "fork_choice", "scripted"
 
@@ -464,7 +504,11 @@ def default_handlers() -> List[Handler]:
         OperationsHandler("voluntary_exit"),
         OperationsHandler("proposer_slashing"),
         OperationsHandler("attester_slashing"),
+        OperationsHandler("deposit"),
+        OperationsHandler("bls_to_execution_change"),
+        OperationsHandler("sync_aggregate"),
         EpochProcessingHandler(),
+        TransitionHandler(),
         ForkChoiceHandler(),
     ]
 

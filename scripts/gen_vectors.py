@@ -153,6 +153,14 @@ def gen_bls():
 # ----------------------------------------------------------------- ssz etc.
 
 
+def _patched_header(types, state):
+    hdr = state.latest_block_header.copy()
+    if bytes(hdr.state_root) == b"\x00" * 32:
+        fork = "capella"
+        hdr.state_root = types.BeaconState[fork].hash_tree_root(state)
+    return hdr
+
+
 def gen_consensus():
     from lighthouse_tpu.testing.harness import BeaconChainHarness
     from lighthouse_tpu.types.spec import minimal_spec
@@ -382,6 +390,161 @@ def gen_consensus():
                      types.AttesterSlashing.serialize(aslash))
     write_ssz(d, "post.ssz", scls.serialize(post_ops))
     write_meta(d, {"valid": True})
+
+    # deposit (valid: proof from the incremental deposit tree)
+    from lighthouse_tpu.crypto.bls import api as bls_api
+    from lighthouse_tpu.eth1.deposit_cache import DepositCache
+    from lighthouse_tpu.types.spec import DOMAIN_DEPOSIT, compute_domain
+
+    dep_sk = bls_api.SecretKey(0xDE9051)
+    dep_pk = dep_sk.public_key().to_bytes()
+    dep_cred = b"\x00" + b"\x11" * 31
+    dep_data = types.DepositData(
+        pubkey=dep_pk, withdrawal_credentials=dep_cred,
+        amount=32 * 10**9,
+    )
+    dep_domain = compute_domain(
+        DOMAIN_DEPOSIT, spec.genesis_fork_version, b"\x00" * 32
+    )
+    from lighthouse_tpu.types.spec import compute_signing_root as _csr
+
+    dep_msg = types.DepositMessage(
+        pubkey=dep_pk, withdrawal_credentials=dep_cred, amount=32 * 10**9
+    )
+    dep_data.signature = dep_sk.sign(
+        _csr(dep_msg, types.DepositMessage, dep_domain)
+    ).to_bytes()
+    cache = DepositCache(types)
+    cache.insert_deposit(dep_data)
+    (data0, proof0), = cache.get_deposits(0, 1, deposit_count=1)
+    dep_state = state_for_ops.copy()
+    dep_state.eth1_data = types.Eth1Data(
+        deposit_root=cache.tree.root_at_count(1), deposit_count=1,
+        block_hash=b"\x22" * 32,
+    )
+    dep_state.eth1_deposit_index = 0
+    deposit = types.Deposit(proof=proof0, data=data0)
+    d = case_dir("minimal", fork, "operations", "deposit", "suite", "valid")
+    write_ssz(d, "pre.ssz", scls.serialize(dep_state))
+    write_ssz(d, "deposit.ssz", types.Deposit.serialize(deposit))
+    post_ops = dep_state.copy()
+    _apply_operation("deposit", post_ops, types, spec, fork,
+                     types.Deposit.serialize(deposit))
+    write_ssz(d, "post.ssz", scls.serialize(post_ops))
+    write_meta(d, {"valid": True})
+
+    # deposit (invalid: corrupted proof)
+    bad_dep = types.Deposit(
+        proof=[b"\xee" * 32] * len(list(deposit.proof)), data=data0
+    )
+    d = case_dir("minimal", fork, "operations", "deposit", "suite",
+                 "bad_proof")
+    write_ssz(d, "pre.ssz", scls.serialize(dep_state))
+    write_ssz(d, "deposit.ssz", types.Deposit.serialize(bad_dep))
+    write_meta(d, {"valid": False})
+
+    # bls_to_execution_change (valid: BLS-credentialed validator rotates)
+    from lighthouse_tpu.types.spec import DOMAIN_BLS_TO_EXECUTION_CHANGE
+
+    wc_sk = h.keys[6]
+    import hashlib as _hl
+
+    blc_state = state_for_ops.copy()
+    blc_state.validators[6].withdrawal_credentials = (
+        b"\x00" + _hl.sha256(wc_sk.public_key().to_bytes()).digest()[1:]
+    )
+    change = types.BLSToExecutionChange(
+        validator_index=6,
+        from_bls_pubkey=wc_sk.public_key().to_bytes(),
+        to_execution_address=b"\x77" * 20,
+    )
+    blc_domain = compute_domain(
+        DOMAIN_BLS_TO_EXECUTION_CHANGE, spec.genesis_fork_version,
+        bytes(blc_state.genesis_validators_root),
+    )
+    signed_change = types.SignedBLSToExecutionChange(
+        message=change,
+        signature=wc_sk.sign(
+            _csr(change, types.BLSToExecutionChange, blc_domain)
+        ).to_bytes(),
+    )
+    d = case_dir("minimal", fork, "operations", "bls_to_execution_change",
+                 "suite", "valid")
+    write_ssz(d, "pre.ssz", scls.serialize(blc_state))
+    write_ssz(d, "bls_to_execution_change.ssz",
+              types.SignedBLSToExecutionChange.serialize(signed_change))
+    post_ops = blc_state.copy()
+    _apply_operation("bls_to_execution_change", post_ops, types, spec, fork,
+                     types.SignedBLSToExecutionChange.serialize(signed_change))
+    write_ssz(d, "post.ssz", scls.serialize(post_ops))
+    write_meta(d, {"valid": True})
+
+    # sync_aggregate (valid: full participation signed by the harness keys)
+    sync_state = state_for_ops.copy()
+    agg = h.make_sync_aggregate(
+        sync_state,
+        types.BeaconBlockHeader.hash_tree_root(
+            _patched_header(types, sync_state)
+        ),
+        sync_state.slot,
+    )
+    d = case_dir("minimal", fork, "operations", "sync_aggregate", "suite",
+                 "full_participation")
+    write_ssz(d, "pre.ssz", scls.serialize(sync_state))
+    write_ssz(d, "sync_aggregate.ssz", types.SyncAggregate.serialize(agg))
+    post_ops = sync_state.copy()
+    _apply_operation("sync_aggregate", post_ops, types, spec, fork,
+                     types.SyncAggregate.serialize(agg))
+    write_ssz(d, "post.ssz", scls.serialize(post_ops))
+    write_meta(d, {"valid": True})
+
+    # sync_aggregate (invalid: bits claim participation the signature lacks)
+    empty_sig_agg = types.SyncAggregate(
+        sync_committee_bits=list(agg.sync_committee_bits),
+        sync_committee_signature=b"\xc0" + b"\x00" * 95,
+    )
+    d = case_dir("minimal", fork, "operations", "sync_aggregate", "suite",
+                 "wrong_signature")
+    write_ssz(d, "pre.ssz", scls.serialize(sync_state))
+    write_ssz(d, "sync_aggregate.ssz",
+              types.SyncAggregate.serialize(empty_sig_agg))
+    write_meta(d, {"valid": False})
+
+    # --- ssz_static for deneb containers (via the capella->deneb upgrade) --
+    from lighthouse_tpu.state_transition import upgrades as up
+
+    deneb_state = up.upgrade_to_deneb(genesis.copy(), types, spec)
+    deneb_samples = {
+        "BeaconState": (types.BeaconState["deneb"], deneb_state),
+        "BlobSidecar": (types.BlobSidecar, types.BlobSidecar(
+            index=1, kzg_commitment=b"\xc1" + b"\x00" * 47,
+            kzg_proof=b"\xc2" + b"\x00" * 47,
+        )),
+    }
+    for name, (cls, obj) in deneb_samples.items():
+        d = case_dir("minimal", "deneb", "ssz_static", "containers",
+                     "suite", name)
+        write_ssz(d, "serialized.ssz", cls.serialize(obj))
+        write_meta(d, {"type": name, "root": hx(cls.hash_tree_root(obj))})
+
+    # --- transition (capella -> deneb at a custom activation epoch) -------
+    import dataclasses as _dc
+
+    tspec = _dc.replace(spec, deneb_fork_epoch=1)
+    t_pre = sp.process_slots(
+        genesis.copy(), types, tspec, spec.preset.SLOTS_PER_EPOCH - 2
+    )
+    t_post = sp.process_slots(
+        t_pre.copy(), types, tspec, spec.preset.SLOTS_PER_EPOCH + 1
+    )
+    d = case_dir("minimal", "capella", "transition", "core", "suite",
+                 "capella_to_deneb")
+    write_ssz(d, "pre.ssz", scls.serialize(t_pre))
+    write_ssz(d, "post.ssz", types.BeaconState["deneb"].serialize(t_post))
+    write_meta(d, {
+        "pre_fork": "capella", "fork": "deneb", "fork_epoch": 1,
+        "to_slot": spec.preset.SLOTS_PER_EPOCH + 1,
+    })
 
     # --- epoch_processing -------------------------------------------------
     pre_epoch = sp.process_slots(

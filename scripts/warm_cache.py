@@ -80,6 +80,16 @@ def main():
     ).block_until_ready()
     print(f"pairing-suite shapes warm ({time.time() - t2:.0f}s)")
 
+    # h2c-suite shapes (tests/test_ops_h2c.py batch of 4).
+    t2b = time.time()
+    from lighthouse_tpu.ops import h2c as _h2c
+
+    msgs4 = [bytes([i]) * 32 for i in range(4)]
+    u4 = _h2c.hash_to_field_device(msgs4)
+    jax.jit(_h2c.hash_to_g2_device)(u4).block_until_ready()
+    jax.jit(_h2c.map_to_curve_sswu)(u4).block_until_ready()
+    print(f"h2c-suite shapes warm ({time.time() - t2b:.0f}s)")
+
     # Device KZG batch verify (tests/test_kzg.py + data-availability path).
     t3 = time.time()
     from lighthouse_tpu.crypto.bls.constants import R as _R
