@@ -29,11 +29,29 @@ class PendingComponents:
 class DataAvailabilityChecker:
     MAX_PENDING = 64  # OverflowLRUCache capacity analog
 
-    def __init__(self, types, kzg=None):
+    def __init__(self, types, kzg=None, device: bool = False):
+        """`device` routes batched KZG verification through the TPU backend
+        (ops/kzg.py) — the per-sidecar gossip check stays on the host
+        (latency-bound single proofs), batch RPC intake goes to device."""
         self.types = types
         self.kzg = kzg
+        self.device = device
         self._pending: "OrderedDict[bytes, PendingComponents]" = OrderedDict()
         self._lock = threading.Lock()
+
+    def verify_blob_batch(self, sidecars) -> bool:
+        """Batched KZG verification for RPC-fetched sidecar sets
+        (BlobsByRange intake): one pairing-product check for the whole
+        batch, on device when configured."""
+        if self.kzg is None or not sidecars:
+            return True
+        return self.kzg.verify_blob_kzg_proof_batch(
+            [bytes(sc.blob) for sc in sidecars],
+            [self._decompress_commitment(sc.kzg_commitment)
+             for sc in sidecars],
+            [self._decompress_commitment(sc.kzg_proof) for sc in sidecars],
+            device=self.device,
+        )
 
     # ---------------------------------------------------------------- intake
 

@@ -147,3 +147,21 @@ def test_auto_register():
     mon = ValidatorMonitor(auto_register=True)
     mon.on_gossip_attestation(3, 0.2)
     assert mon.on_epoch_summary(0, {3})[3]["seen"] == 1
+
+
+def test_batched_blob_verification_device_and_host(rig):
+    """verify_blob_batch: one pairing-product check per sidecar batch,
+    host and device paths agreeing (RPC BlobsByRange intake)."""
+    types, kzg = rig
+    sidecars = []
+    for i in range(3):
+        sc, _c = _sidecar(kzg, i, [40 + i * 3 + j for j in range(N)])
+        sidecars.append(sc)
+    for device in (False, True):
+        checker = DataAvailabilityChecker(types, kzg, device=device)
+        assert checker.verify_blob_batch(sidecars)
+        bad = sidecars[:2] + [FakeSidecar(
+            2, sidecars[2].blob, sidecars[2].kzg_commitment,
+            sidecars[0].kzg_proof,  # wrong proof
+        )]
+        assert not checker.verify_blob_batch(bad)
