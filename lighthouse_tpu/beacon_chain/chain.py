@@ -61,6 +61,7 @@ class BeaconChain:
         op_pool=None,
         deposit_cache=None,
         anchor_block=None,
+        da_checker=None,
     ):
         """`genesis_state` is the chain's *anchor* state — actual genesis for
         a fresh chain, or a finalized checkpoint state for checkpoint sync
@@ -74,6 +75,7 @@ class BeaconChain:
         self.execution_layer = execution_layer
         self.op_pool = op_pool
         self.deposit_cache = deposit_cache  # eth1 follower (deposits)
+        self.da_checker = da_checker        # deneb blob availability
         self._lock = threading.RLock()
 
         fork = spec.fork_name_at_epoch(spec.epoch_at_slot(genesis_state.slot))
@@ -372,6 +374,35 @@ class BeaconChain:
                 verified.indexed_attestation,
             )
         return verified
+
+    def process_rpc_blobs(self, block_root: bytes, sidecars) -> list:
+        """RPC-fetched sidecars (BlobsByRange/BlobsByRoot responses): ONE
+        batched KZG check for the whole response, then feed the checker —
+        the batch path the reference's sync blob coupling uses instead of
+        gossip's per-sidecar verification. A by-range response spans
+        MULTIPLE blocks: each sidecar files under its own
+        signed_block_header's root when it carries one; `block_root` is the
+        fallback for header-less (test/duck-typed) sidecars. Returns any
+        completed pending blocks the sidecars unblocked."""
+        from .data_availability import AvailabilityError
+
+        if self.da_checker is None:
+            return []
+        if not self.da_checker.verify_blob_batch(sidecars):
+            raise AvailabilityError("rpc blob batch failed KZG verification")
+        completed = []
+        for sc in sidecars:
+            root = block_root
+            header = getattr(sc, "signed_block_header", None)
+            if header is not None and int(header.message.slot) != 0:
+                root = self.types.BeaconBlockHeader.hash_tree_root(
+                    header.message
+                )
+            done = self.da_checker.put_gossip_blob(root, sc,
+                                                   pre_verified=True)
+            if done is not None:
+                completed.append(done)
+        return completed
 
     def process_sync_committee_message(self, message, subnet_id=None):
         """Gossip sync-committee message: verify + fold into the

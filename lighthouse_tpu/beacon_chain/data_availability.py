@@ -40,18 +40,29 @@ class DataAvailabilityChecker:
         self._lock = threading.Lock()
 
     def verify_blob_batch(self, sidecars) -> bool:
-        """Batched KZG verification for RPC-fetched sidecar sets
-        (BlobsByRange intake): one pairing-product check for the whole
-        batch, on device when configured."""
+        """Batched KZG verification for RPC-fetched sidecar sets: one
+        pairing-product check for the whole batch, on device when
+        configured. Malformed points verify False (the peer sent garbage;
+        bool contract preserved)."""
         if self.kzg is None or not sidecars:
             return True
-        return self.kzg.verify_blob_kzg_proof_batch(
-            [bytes(sc.blob) for sc in sidecars],
-            [self._decompress_commitment(sc.kzg_commitment)
-             for sc in sidecars],
-            [self._decompress_commitment(sc.kzg_proof) for sc in sidecars],
-            device=self.device,
-        )
+        from lighthouse_tpu.crypto.kzg import KzgError
+
+        try:
+            commitments = [self._decompress_commitment(sc.kzg_commitment)
+                           for sc in sidecars]
+            proofs = [self._decompress_commitment(sc.kzg_proof)
+                      for sc in sidecars]
+            return self.kzg.verify_blob_kzg_proof_batch(
+                [bytes(sc.blob) for sc in sidecars],
+                commitments,
+                proofs,
+                device=self.device,
+            )
+        except (ValueError, KzgError):
+            # Malformed points OR non-canonical blob field elements: the
+            # peer sent garbage; the batch verifies False, it doesn't crash.
+            return False
 
     # ---------------------------------------------------------------- intake
 
@@ -61,21 +72,28 @@ class DataAvailabilityChecker:
             return len(body.blob_kzg_commitments)
         return 0
 
-    def put_gossip_blob(self, block_root: bytes, sidecar) -> Optional[object]:
+    def put_gossip_blob(self, block_root: bytes, sidecar,
+                        pre_verified: bool = False) -> Optional[object]:
         """Store a KZG-verified sidecar; returns the completed
         ExecutionPendingBlock when it was the last missing piece
-        (put_gossip_blob :226)."""
+        (put_gossip_blob :226). `pre_verified` skips the per-sidecar proof
+        (the RPC intake already batch-verified the whole response)."""
         max_blobs = getattr(self.types.preset, "MAX_BLOBS_PER_BLOCK", 6)
         if int(sidecar.index) >= max_blobs:
             raise AvailabilityError(
                 f"blob index {int(sidecar.index)} >= MAX_BLOBS_PER_BLOCK"
             )
-        if self.kzg is not None:
-            ok = self.kzg.verify_blob_kzg_proof(
-                bytes(sidecar.blob),
-                self._decompress_commitment(sidecar.kzg_commitment),
-                self._decompress_commitment(sidecar.kzg_proof),
-            )
+        if self.kzg is not None and not pre_verified:
+            from lighthouse_tpu.crypto.kzg import KzgError
+
+            try:
+                ok = self.kzg.verify_blob_kzg_proof(
+                    bytes(sidecar.blob),
+                    self._decompress_commitment(sidecar.kzg_commitment),
+                    self._decompress_commitment(sidecar.kzg_proof),
+                )
+            except (ValueError, KzgError) as e:
+                raise AvailabilityError(f"blob {sidecar.index}: {e}")
             if not ok:
                 raise AvailabilityError(f"blob {sidecar.index} failed KZG")
         with self._lock:
@@ -129,6 +147,12 @@ class DataAvailabilityChecker:
 
     @staticmethod
     def _decompress_commitment(data: bytes):
+        """Decompress + SUBGROUP-CHECK an untrusted G1 commitment/proof
+        (c-kzg's validate_kzg_g1: an on-curve point outside the r-subgroup
+        would make the batched pairing equation unsound, not just false)."""
         from lighthouse_tpu.crypto.bls import curves as cv
 
-        return cv.g1_from_compressed(bytes(data))
+        pt = cv.g1_from_compressed(bytes(data))
+        if pt is not None and not cv.g1_in_subgroup(pt):
+            raise ValueError("G1 point not in the r-subgroup")
+        return pt

@@ -59,6 +59,8 @@ class ClientConfig:
     real_clock: bool = False
     slots_per_restore_point: int = 2048
     simulate_attestations: bool = False      # attestation_simulator.rs service
+    kzg: object = None                       # Kzg trusted setup (deneb blobs)
+    kzg_device: bool = False                 # batch KZG on the TPU backend
 
 
 class Client:
@@ -263,6 +265,15 @@ class ClientBuilder:
             execution_layer = ExecutionLayer(engine, types=types)
 
         op_pool = OperationPool(types, spec)
+        da_checker = None
+        if cfg.kzg is not None:
+            from lighthouse_tpu.beacon_chain.data_availability import (
+                DataAvailabilityChecker,
+            )
+
+            da_checker = DataAvailabilityChecker(
+                types, cfg.kzg, device=cfg.kzg_device
+            )
         chain = BeaconChain(
             types, spec, genesis_state,
             store=store,
@@ -270,6 +281,7 @@ class ClientBuilder:
             execution_layer=execution_layer,
             op_pool=op_pool,
             anchor_block=anchor_block,
+            da_checker=da_checker,
         )
         if cfg.real_clock:
             chain.slot_clock = SystemTimeSlotClock(

@@ -61,16 +61,28 @@ def _combine(commit_proj, proof_proj, g2_neg_proj, g2_x_minus, g1_gen_proj,
 
     The two-pair identity (batch form of verify_kzg_proof):
         e(sum r^i (C_i - y_i G1 + z_i W_i), -G2) * e(sum r^i W_i, tau G2) == 1
+
+    The four wide scalar multiplications run as TWO stacked scans (one
+    compiled 256-step body each, 2n lanes) — halves both the compiled
+    program size (the XLA:CPU executable otherwise grows past what the
+    cache can serialize) and the scan dispatch count.
     """
     n = commit_proj.shape[0]
     g1b = jnp.broadcast_to(g1_gen_proj, commit_proj.shape)
-    y_g1 = cv.G1.mul_var_scalar_wide(g1b, y_words)
-    z_w = cv.G1.mul_var_scalar_wide(proof_proj, z_words)
+    # Stage A: [y_i]G1 and [z_i]W_i in one (2n)-lane scan.
+    a = cv.G1.mul_var_scalar_wide(
+        jnp.concatenate([g1b, proof_proj]),
+        jnp.concatenate([y_words, z_words]),
+    )
+    y_g1, z_w = a[:n], a[n:]
     term = cv.G1.add(cv.G1.add(commit_proj, cv.G1.neg(y_g1)), z_w)
-    lhs = cv.G1.mul_var_scalar_wide(term, r_words)
-    wr = cv.G1.mul_var_scalar_wide(proof_proj, r_words)
-    lhs_sum = cv.G1.msm_reduce(lhs, n)
-    w_sum = cv.G1.msm_reduce(wr, n)
+    # Stage B: r^i-weighting of both pairing inputs in one scan.
+    b = cv.G1.mul_var_scalar_wide(
+        jnp.concatenate([term, proof_proj]),
+        jnp.concatenate([r_words, r_words]),
+    )
+    lhs_sum = cv.G1.msm_reduce(b[:n], n)
+    w_sum = cv.G1.msm_reduce(b[n:], n)
 
     p_aff = pr.to_affine_g1(jnp.stack([lhs_sum, w_sum]))
     q_aff = jnp.stack([g2_neg_proj, g2_x_minus])

@@ -36,3 +36,16 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running integration test (simulator-scale)"
     )
+
+
+def pytest_collection_modifyitems(config, items):
+    """Run the compile-heavy kernel suites FIRST. XLA:CPU's compiler
+    segfaults sporadically when large modules compile late in a LONG
+    many-module process (observed repeatedly at test_ops_h2c /
+    test_ops_pairing around the 50-75% mark; the same compiles succeed
+    in young processes — see scripts/warm_cache.py). Stable sort keeps
+    relative order within each group."""
+    heavy = ("test_ops_", "test_backend", "test_bisection", "test_kzg")
+    items.sort(
+        key=lambda it: 0 if it.fspath.basename.startswith(heavy) else 1
+    )

@@ -157,7 +157,11 @@ def test_batched_blob_verification_device_and_host(rig):
     for i in range(3):
         sc, _c = _sidecar(kzg, i, [40 + i * 3 + j for j in range(N)])
         sidecars.append(sc)
-    for device in (False, True):
+    import os as _os
+
+    devices = (False, True) if _os.environ.get(
+        "LIGHTHOUSE_TPU_DEVICE_KZG_TESTS") else (False,)
+    for device in devices:
         checker = DataAvailabilityChecker(types, kzg, device=device)
         assert checker.verify_blob_batch(sidecars)
         bad = sidecars[:2] + [FakeSidecar(
@@ -165,3 +169,34 @@ def test_batched_blob_verification_device_and_host(rig):
             sidecars[0].kzg_proof,  # wrong proof
         )]
         assert not checker.verify_blob_batch(bad)
+
+
+def test_chain_rpc_blob_intake(rig):
+    """chain.process_rpc_blobs: batched KZG check per RPC response, then
+    availability completion; garbage points verify False (no crash)."""
+    import pytest as _pytest
+
+    from lighthouse_tpu.beacon_chain.data_availability import (
+        AvailabilityError,
+        DataAvailabilityChecker,
+    )
+    from lighthouse_tpu.testing.harness import BeaconChainHarness
+
+    types, kzg = rig
+    h = BeaconChainHarness(n_validators=16, bls_backend="fake")
+    chain = h.chain
+    chain.da_checker = DataAvailabilityChecker(types, kzg)
+
+    sc0, c0 = _sidecar(kzg, 0, [9 + j for j in range(N)])
+    sc1, c1 = _sidecar(kzg, 1, [21 + j for j in range(N)])
+    root = b"\xab" * 32
+    pending = FakePending(types, [sc0.kzg_commitment, sc1.kzg_commitment])
+    assert chain.da_checker.put_pending_block(root, pending) is None
+
+    done = chain.process_rpc_blobs(root, [sc0, sc1])
+    assert len(done) == 1 and done[0] is pending
+
+    # A garbage commitment in the response: whole batch rejected, loudly.
+    bad = FakeSidecar(0, sc0.blob, b"\x8f" + b"\x11" * 47, sc0.kzg_proof)
+    with _pytest.raises(AvailabilityError):
+        chain.process_rpc_blobs(b"\xcd" * 32, [bad])

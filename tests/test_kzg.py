@@ -1,6 +1,8 @@
 """KZG commitments: evaluation, proof verify, blob proofs, batch verify
 (reference: crypto/kzg + c-kzg semantics; ef_test KZG case shapes §4.2)."""
 
+import os
+
 import pytest
 
 from lighthouse_tpu.crypto.bls.constants import R
@@ -87,6 +89,12 @@ def test_empty_batch_is_valid(kzg):
     assert kzg.verify_blob_kzg_proof_batch([], [], [])
 
 
+@pytest.mark.skipif(
+    not os.environ.get("LIGHTHOUSE_TPU_DEVICE_KZG_TESTS"),
+    reason="device-KZG compile inside a full pytest run destabilizes "
+           "XLA:CPU for later heavy compiles (see scripts/warm_cache.py); "
+           "run this file alone or set LIGHTHOUSE_TPU_DEVICE_KZG_TESTS=1",
+)
 def test_device_batch_verify_matches_oracle(kzg):
     """ops/kzg.py: the device G1-combination + pairing path agrees with the
     oracle on valid batches and rejects corrupted ones."""
