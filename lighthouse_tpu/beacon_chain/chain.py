@@ -20,6 +20,7 @@ from dataclasses import dataclass
 from typing import List, Optional
 
 from lighthouse_tpu.common.slot_clock import ManualSlotClock, SlotClock
+from lighthouse_tpu.execution_layer.execution_layer import normalize_lvh
 from lighthouse_tpu.fork_choice.fork_choice import CheckpointSnapshot, ForkChoice
 from lighthouse_tpu.fork_choice.proto_array import ExecutionStatus
 from lighthouse_tpu.state_transition import helpers as h
@@ -669,10 +670,8 @@ class BeaconChain:
             ) or {}
             ps = out.get("payloadStatus") or {}
             if ps.get("status") == "INVALID":
-                lvh = ps.get("latestValidHash")
                 moved = self.process_invalid_execution_payload(
-                    head_hash,
-                    bytes.fromhex(lvh[2:]) if isinstance(lvh, str) else lvh,
+                    head_hash, normalize_lvh(ps.get("latestValidHash"))
                 )
                 if not moved:
                     return

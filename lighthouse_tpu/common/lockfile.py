@@ -41,11 +41,13 @@ class Lockfile:
         return self
 
     def release(self) -> None:
+        # Deliberately do NOT unlink the lock file: unlink-before-unlock
+        # lets a waiter that already opened the old path acquire the flock
+        # on the orphaned inode while a third process creates and locks a
+        # fresh file at the same path — two holders, exactly the double-run
+        # hazard this module exists to prevent. The empty file persisting
+        # is harmless; flock alone arbitrates ownership.
         if self._fd is not None:
-            try:
-                os.unlink(self.path)
-            except FileNotFoundError:
-                pass
             fcntl.flock(self._fd, fcntl.LOCK_UN)
             os.close(self._fd)
             self._fd = None

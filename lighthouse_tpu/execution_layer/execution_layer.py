@@ -23,6 +23,19 @@ class ExecutionLayerError(Exception):
     pass
 
 
+def normalize_lvh(lvh) -> Optional[bytes]:
+    """Normalize a latestValidHash from an engine response: hex-decode, and
+    map the all-zero hash to None — per the engine API it means "no valid
+    ancestor known", not a hash to locate and ratify. Shared by
+    newPayload (verify_payload) and fcU (chain.update_execution_engine_
+    forkchoice) so both INVALID provenances normalize identically."""
+    if isinstance(lvh, str):
+        lvh = bytes.fromhex(lvh[2:] if lvh[:2] in ("0x", "0X") else lvh)
+    if lvh == b"\x00" * 32:
+        lvh = None
+    return lvh
+
+
 class ExecutionLayer:
     def __init__(self, engine, types=None, fork: str = "capella",
                  fee_recipient: bytes = b"\x00" * 20, builder=None):
@@ -61,11 +74,7 @@ class ExecutionLayer:
                 self.engine_online = False
                 return "SYNCING", None  # EL offline => optimistic import
         s = status.get("status", "SYNCING")
-        lvh = status.get("latestValidHash")
-        if isinstance(lvh, str):
-            lvh = bytes.fromhex(lvh[2:])
-        if lvh == b"\x00" * 32:
-            lvh = None
+        lvh = normalize_lvh(status.get("latestValidHash"))
         if s in ("VALID",):
             return "VALID", lvh
         if s in ("INVALID", "INVALID_BLOCK_HASH"):

@@ -182,7 +182,10 @@ class SlasherPersistence:
     def prune(self, low_epoch: int) -> int:
         """Drop records below the history window. Keys sort target-first, so
         this is a prefix scan that STOPS at the first in-window record —
-        cost proportional to what's pruned, not to the whole column."""
+        cost proportional to what's pruned, not to the whole column.
+        Records still queued for flush below the window are dropped too —
+        they would otherwise be re-persisted by the next flush()."""
+        self._new_records = [r for r in self._new_records if r[2] >= low_epoch]
         drop = []
         for key, _ in self.backend.iter_column(_COL_REC):
             if _unrec_key(key)[2] >= low_epoch:

@@ -174,10 +174,12 @@ class Slasher:
             self._records = {
                 k: val for k, val in self._records.items() if k[2] >= low
             }
-        # Disk pruning runs OUTSIDE the lock: it must not stall the
-        # gossip/import attestation path while the DB churns.
-        if self.persistence is not None:
-            self.persistence.prune(low)
+            # The backend prune must not interleave with flush()'s puts
+            # (flush holds this lock). The scan cost is proportional to
+            # what's pruned (target-first key order), so holding the lock
+            # is a bounded stall.
+            if self.persistence is not None:
+                self.persistence.prune(low)
 
 
 class SlasherService:

@@ -19,6 +19,14 @@ from lighthouse_tpu.crypto import keystore as ks
 from lighthouse_tpu.crypto.bls.api import SecretKey
 
 
+def _norm_pk_hex(pk_hex: str) -> str:
+    """Lowercase and strip an optional 0x prefix (case-insensitive — a
+    '0X' prefix must neither crash fromhex nor silently miss the
+    slashing-history filter)."""
+    pk_hex = pk_hex.lower()
+    return pk_hex[2:] if pk_hex.startswith("0x") else pk_hex
+
+
 class KeymanagerApi:
     def __init__(self, store, genesis_validators_root: bytes = b"\x00" * 32,
                  token: Optional[str] = None, port: int = 0):
@@ -149,7 +157,7 @@ class KeymanagerApi:
         password = body["password"]
         statuses, keystores = [], []
         for pk_hex in body.get("pubkeys", []):
-            pk = bytes.fromhex(pk_hex[2:] if pk_hex.startswith("0x") else pk_hex)
+            pk = bytes.fromhex(_norm_pk_hex(pk_hex))
             sk = self.store.local_secret_key(pk)
             if sk is None:
                 statuses.append({"status": "error",
@@ -166,11 +174,10 @@ class KeymanagerApi:
         # Only the moving keys' history travels — seeding the destination
         # with unrelated validators' records would collide with their own
         # later moves.
-        wanted = {pk.lower() if pk.startswith("0x") else "0x" + pk.lower()
-                  for pk in body.get("pubkeys", [])}
+        wanted = {_norm_pk_hex(pk) for pk in body.get("pubkeys", [])}
         interchange["data"] = [
             rec for rec in interchange.get("data", [])
-            if rec.get("pubkey", "").lower() in wanted
+            if _norm_pk_hex(rec.get("pubkey", "")) in wanted
         ]
         return {"data": statuses, "keystores": keystores,
                 "slashing_protection": json.dumps(interchange)}
@@ -178,7 +185,7 @@ class KeymanagerApi:
     def _delete_keystores(self, body) -> dict:
         statuses = []
         for pk_hex in body.get("pubkeys", []):
-            pk = bytes.fromhex(pk_hex[2:] if pk_hex.startswith("0x") else pk_hex)
+            pk = bytes.fromhex(_norm_pk_hex(pk_hex))
             if self.store.remove_validator(pk):
                 statuses.append({"status": "deleted"})
             else:

@@ -169,3 +169,39 @@ def test_fcu_invalid_verdict_retreats_head():
     engine.forkchoice_updated = invalid_fcu
     chain.update_execution_engine_forkchoice()
     assert chain.head.block_root == good_head
+
+
+def test_fcu_invalid_zero_lvh_means_no_valid_ancestor():
+    """Engine API: latestValidHash == 0x00..00 on INVALID means 'no valid
+    ancestor known', NOT a hash to locate and ratify. The retreat must
+    treat it as None (walk back to the first EL-ratified / pre-merge
+    ancestor) rather than searching for a zero-hash node."""
+    harness, engine, el = _harness_with_el()
+    chain = harness.chain
+    harness.extend_chain(1, attest=False)
+    good_head = chain.head.block_root
+
+    forced = {"on": True}
+    _force_syncing(engine, forced)
+    bad_root, _ = harness.extend_chain(1, attest=False)[0]
+    forced["on"] = False
+    engine.on_new_payload = None
+    bad_hash = _exec_hash(chain, bad_root)
+
+    real_fcu = engine.forkchoice_updated
+
+    def invalid_fcu(head, safe, fin, attrs):
+        if bytes(head) == bad_hash:
+            return {"payloadStatus": {
+                "status": "INVALID",
+                "latestValidHash": "0x" + "00" * 32,
+            }, "payloadId": None}
+        return real_fcu(head, safe, fin, attrs)
+
+    engine.forkchoice_updated = invalid_fcu
+    chain.update_execution_engine_forkchoice()
+    proto = chain.fork_choice.proto
+    assert proto.nodes[
+        proto.index_by_root[bad_root]
+    ].execution_status is ExecutionStatus.INVALID
+    assert chain.head.block_root == good_head
