@@ -303,3 +303,202 @@ class ObservedBlockProducers:
         with self._lock:
             for s in [s for s in self._map if s < finalized_slot]:
                 del self._map[s]
+
+
+class CommitteeLengths:
+    """Minimal data to compute any committee length in one epoch: the
+    active-validator count (attester_cache.rs CommitteeLengths). The
+    committee MEMBERSHIP needs the shuffling; the LENGTH (all an
+    AttestationData producer needs) only needs the count."""
+
+    def __init__(self, epoch: int, active_count: int):
+        self.epoch = epoch
+        self.active_count = active_count
+
+    @classmethod
+    def from_state(cls, state, spec, epoch: int) -> "CommitteeLengths":
+        from lighthouse_tpu.state_transition import helpers as h
+
+        return cls(epoch, len(h.get_active_validator_indices(state, epoch)))
+
+    def committee_count_per_slot(self, spec) -> int:
+        P = spec.preset
+        return max(1, min(
+            P.MAX_COMMITTEES_PER_SLOT,
+            self.active_count // P.SLOTS_PER_EPOCH // P.TARGET_COMMITTEE_SIZE,
+        ))
+
+    def committee_length(self, spec, slot: int, index: int) -> int:
+        """Spec compute_committee slice length for (slot, index)."""
+        P = spec.preset
+        per_slot = self.committee_count_per_slot(spec)
+        total = per_slot * P.SLOTS_PER_EPOCH
+        k = (slot % P.SLOTS_PER_EPOCH) * per_slot + index
+        start = self.active_count * k // total
+        end = self.active_count * (k + 1) // total
+        return end - start
+
+
+class EarlyAttesterCache:
+    """Single-item cache allowing attestation to the just-imported head
+    block BEFORE it reaches the database / head recompute finishes
+    (early_attester_cache.rs:39). Also answers block-root existence and
+    block-by-root for gossip verification and RPC fast paths."""
+
+    def __init__(self):
+        self._item = None
+        self._lock = threading.Lock()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._item = None
+
+    def add_head_block(self, block_root: bytes, signed_block, state,
+                       spec) -> None:
+        from lighthouse_tpu.state_transition import helpers as h
+
+        epoch = spec.epoch_at_slot(state.slot)
+        start = spec.start_slot_of_epoch(epoch)
+        if signed_block.message.slot == start:
+            target_root = block_root
+        else:
+            target_root = h.get_block_root_at_slot(state, spec, start)
+        with self._lock:
+            self._item = {
+                "epoch": epoch,
+                "lengths": CommitteeLengths.from_state(state, spec, epoch),
+                "block_root": block_root,
+                "block_slot": signed_block.message.slot,
+                "source": state.current_justified_checkpoint,
+                "target_epoch": epoch,
+                "target_root": target_root,
+                "block": signed_block,
+            }
+
+    def try_attest(self, types, spec, slot: int, committee_index: int):
+        """AttestationData for (slot, index) if the cached item covers it
+        (same epoch, slot not before the block) — else None."""
+        with self._lock:
+            item = self._item
+        if item is None:
+            return None
+        if spec.epoch_at_slot(slot) != item["epoch"]:
+            return None
+        if slot < item["block_slot"]:
+            return None
+        if committee_index >= item["lengths"].committee_count_per_slot(spec):
+            return None
+        return types.AttestationData(
+            slot=slot,
+            index=committee_index,
+            beacon_block_root=item["block_root"],
+            source=item["source"],
+            target=types.Checkpoint(epoch=item["target_epoch"],
+                                    root=item["target_root"]),
+        )
+
+    def contains_block(self, block_root: bytes) -> bool:
+        with self._lock:
+            return self._item is not None and \
+                self._item["block_root"] == block_root
+
+    def get_block(self, block_root: bytes):
+        with self._lock:
+            if self._item is not None and \
+                    self._item["block_root"] == block_root:
+                return self._item["block"]
+        return None
+
+
+class AttesterCache:
+    """(epoch, head block root) -> (justified checkpoint, committee
+    lengths): everything cross-epoch AttestationData production needs
+    beyond what the ShufflingCache holds (attester_cache.rs:251 — the
+    justified checkpoint cannot ride the shuffling cache because it only
+    becomes known after per-epoch processing). Filled from the advanced
+    head-state clone the FIRST time an epoch is attested across a skipped
+    boundary; every later request in that epoch skips the state replay."""
+
+    MAX_LEN = 1024
+
+    def __init__(self):
+        self._map: Dict[tuple, tuple] = {}
+        self._lock = threading.Lock()
+
+    def cache_advanced(self, head_root: bytes, advanced_state, spec,
+                       epoch: int) -> None:
+        """Record the epoch data derived from advancing `head_root`'s state
+        to `epoch` (idempotent)."""
+        k = (epoch, head_root)
+        with self._lock:
+            if k in self._map:
+                return
+            if len(self._map) >= self.MAX_LEN:
+                self._map.pop(next(iter(self._map)))
+            self._map[k] = (
+                advanced_state.current_justified_checkpoint,
+                CommitteeLengths.from_state(advanced_state, spec, epoch),
+            )
+
+    def get(self, epoch: int, head_root: bytes):
+        with self._lock:
+            return self._map.get((epoch, head_root))
+
+    def prune(self, finalized_epoch: int) -> None:
+        with self._lock:
+            for k in [k for k in self._map if k[0] < finalized_epoch]:
+                del self._map[k]
+
+
+class BlockTimesCache:
+    """Per-block observed -> imported -> set-as-head timestamps for delay
+    forensics (block_times_cache.rs; feeds the validator monitor's
+    gossip-delay metrics and the http API's block-delay fields)."""
+
+    RETAIN_SLOTS = 64
+
+    def __init__(self):
+        self._map: Dict[bytes, dict] = {}
+        self._lock = threading.Lock()
+
+    def _entry(self, block_root: bytes, slot: int) -> dict:
+        return self._map.setdefault(block_root, {"slot": slot})
+
+    def set_time_observed(self, block_root: bytes, slot: int, ts: float,
+                          peer_id=None) -> None:
+        with self._lock:
+            e = self._entry(block_root, slot)
+            # Keep the EARLIEST observation (a block can arrive from many
+            # peers).
+            if "observed" not in e or ts < e["observed"]:
+                e["observed"] = ts
+                e["peer"] = peer_id
+
+    def set_time_imported(self, block_root: bytes, slot: int, ts: float) -> None:
+        with self._lock:
+            self._entry(block_root, slot)["imported"] = ts
+
+    def set_time_set_as_head(self, block_root: bytes, slot: int, ts: float) -> None:
+        with self._lock:
+            self._entry(block_root, slot)["set_as_head"] = ts
+
+    def get_block_delays(self, block_root: bytes, slot_start: float) -> dict:
+        """Delays relative to the slot start (block_times_cache.rs
+        get_block_delays): observed, imported (from observed), and
+        set_as_head (from imported)."""
+        with self._lock:
+            e = self._map.get(block_root, {})
+            out = {}
+            if "observed" in e:
+                out["observed"] = max(0.0, e["observed"] - slot_start)
+            if "imported" in e and "observed" in e:
+                out["imported"] = e["imported"] - e["observed"]
+            if "set_as_head" in e and "imported" in e:
+                out["set_as_head"] = e["set_as_head"] - e["imported"]
+            return out
+
+    def prune(self, current_slot: int) -> None:
+        with self._lock:
+            low = current_slot - self.RETAIN_SLOTS
+            for r in [r for r, e in self._map.items() if e["slot"] < low]:
+                del self._map[r]

@@ -31,6 +31,9 @@ from . import attestation_verification as att_ver
 from . import block_verification as blk_ver
 from .block_verification import BlockError
 from .caches import (
+    AttesterCache,
+    BlockTimesCache,
+    EarlyAttesterCache,
     ObservedAttesters,
     ObservedBlockProducers,
     ObservedItems,
@@ -153,6 +156,9 @@ class BeaconChain:
         self.observed_aggregates = ObservedItems()
         self.observed_block_producers = ObservedBlockProducers()
         self.observed_sync_contributors = ObservedAttesters()
+        self.early_attester_cache = EarlyAttesterCache()
+        self.attester_cache = AttesterCache()
+        self.block_times_cache = BlockTimesCache()
 
         from .sync_committee import SyncContributionPool
 
@@ -237,6 +243,13 @@ class BeaconChain:
     def process_block(self, signed_block) -> bytes:
         """Full import pipeline; returns the block root
         (beacon_chain.rs:2982 process_block)."""
+        self.block_times_cache.set_time_observed(
+            self.types.BeaconBlock[
+                self.fork_at(signed_block.message.slot)
+            ].hash_tree_root(signed_block.message),
+            signed_block.message.slot,
+            self.slot_clock._now_seconds(),
+        )
         with self._lock:
             gossip = blk_ver.gossip_verify_block(self, signed_block)
             sig = blk_ver.signature_verify_block(self, gossip)
@@ -289,6 +302,20 @@ class BeaconChain:
             self._state_root_by_block[root] = state_root
             self.snapshot_cache.insert(root, state, pending.signed_block)
             self.pubkey_cache.import_new_pubkeys(state)
+            # Attestations to this block can be produced from here on,
+            # without waiting for the head recompute / database round-trip
+            # (early_attester_cache.rs add_head_block) — but ONLY for a
+            # block extending the current head: caching a side-fork block
+            # would hijack attestation production onto a losing fork.
+            # recompute_head below additionally clears the cache if the
+            # winner differs.
+            if bytes(block.parent_root) == self.head.block_root:
+                self.early_attester_cache.add_head_block(
+                    root, pending.signed_block, state, self.spec
+                )
+            self.block_times_cache.set_time_imported(
+                root, block.slot, self.slot_clock._now_seconds()
+            )
 
             self.recompute_head()
             self.store.put_head_info(self.head.block_root,
@@ -328,6 +355,8 @@ class BeaconChain:
         self.observed_aggregators.prune(fin_epoch)
         fin_slot = self.spec.start_slot_of_epoch(fin_epoch)
         self.observed_aggregates.prune(fin_slot)
+        self.attester_cache.prune(fin_epoch)
+        self.block_times_cache.prune(self.current_slot())
         self.observed_block_producers.prune(fin_slot)
         fin_root = self.fork_choice.finalized.root
         state_root = self._state_root_by_block.get(fin_root)
@@ -438,10 +467,48 @@ class BeaconChain:
 
     def produce_unaggregated_attestation(self, slot: int, committee_index: int):
         """AttestationData for (slot, index) at the current head
-        (beacon_chain.rs:1742)."""
-        state = self.head_state_clone_at(slot)
+        (beacon_chain.rs:1742), with the early-attester fast path
+        (early_attester_cache.rs:39) tried first: a just-imported block is
+        attestable before the head recompute / store round-trip."""
+        early = self.early_attester_cache.try_attest(
+            self.types, self.spec, slot, committee_index
+        )
+        if early is not None:
+            return early
         t, spec = self.types, self.spec
         epoch = spec.epoch_at_slot(slot)
+        head_state = self.head.state
+        if epoch > spec.epoch_at_slot(head_state.slot):
+            # Cross-epoch request (skipped slots over the boundary): the
+            # attester cache supplies the justified checkpoint + committee
+            # count without replaying the head state (attester_cache.rs).
+            hit = self.attester_cache.get(
+                epoch, self.head.block_root
+            )
+            if hit is not None:
+                justified, lengths = hit
+                if committee_index < lengths.committee_count_per_slot(spec):
+                    target_start = spec.start_slot_of_epoch(epoch)
+                    if target_start <= head_state.slot:
+                        target_root = h.get_block_root_at_slot(
+                            head_state, spec, target_start
+                        )
+                    else:
+                        target_root = self.head.block_root
+                    return t.AttestationData(
+                        slot=slot,
+                        index=committee_index,
+                        beacon_block_root=self.head.block_root,
+                        source=justified,
+                        target=t.Checkpoint(epoch=epoch, root=target_root),
+                    )
+        state = self.head_state_clone_at(slot)
+        if epoch > spec.epoch_at_slot(head_state.slot):
+            # Fill the cache from the advanced clone so the NEXT request
+            # in this epoch skips the replay.
+            self.attester_cache.cache_advanced(
+                self.head.block_root, state, spec, epoch
+            )
         if slot < state.slot:
             head_root = h.get_block_root_at_slot(state, spec, slot)
         else:
@@ -776,4 +843,23 @@ class BeaconChain:
                 state=state,
                 state_root=state_root or b"",
             )
+            now = self.slot_clock._now_seconds()
+            self.block_times_cache.set_time_set_as_head(
+                head_root, state.slot, now
+            )
+            # Fork-choice picked a different block than the early-attester
+            # candidate: drop it so attestation production follows the head.
+            if not self.early_attester_cache.contains_block(head_root):
+                self.early_attester_cache.clear()
+            # Delay forensics (metrics.rs beacon_block_* delay histograms).
+            from lighthouse_tpu.common.metrics import REGISTRY
+
+            delays = self.block_times_cache.get_block_delays(
+                head_root, self.slot_clock.start_of(state.slot)
+            )
+            for phase, value in delays.items():
+                REGISTRY.histogram(
+                    f"beacon_block_{phase}_delay_seconds",
+                    "block pipeline delay relative to the slot start",
+                ).observe(value)
             return head_root

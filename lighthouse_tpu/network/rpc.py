@@ -66,6 +66,8 @@ class RpcHandler:
         self.handlers: Dict[str, Callable] = {}
         self._req_seq = 0
         self._pending: Dict[int, List[Tuple[int, bytes]]] = {}
+        self._done: Dict[int, threading.Event] = {}
+        self._req_peer: Dict[int, str] = {}   # req_id -> dst (spoof guard)
         self._buckets: Dict[Tuple[str, str], TokenBucket] = {}
         self._lock = threading.RLock()
 
@@ -78,18 +80,31 @@ class RpcHandler:
     def request(self, dst: str, protocol: str, payload: bytes,
                 timeout: float = 10.0) -> List[bytes]:
         """Send a request; returns decoded response chunks. Raises RpcError
-        on error codes."""
+        on error codes. Responses stream in asynchronously (real sockets);
+        an end-of-response marker terminates the wait — the in-process
+        SimTransport sets it synchronously inside `send`, so the wait is
+        free there."""
+        done = threading.Event()
         with self._lock:
             self._req_seq += 1
             req_id = self._req_seq
             self._pending[req_id] = []
+            self._done[req_id] = done
+            self._req_peer[req_id] = dst
         self.transport.send(
             self.peer_id, dst,
             ("rpc_req", req_id, protocol, encode_frame(payload)),
         )
-        # In-process transport delivers synchronously; chunks are waiting.
+        finished = done.wait(timeout)
         with self._lock:
             chunks = self._pending.pop(req_id, [])
+            self._done.pop(req_id, None)
+            self._req_peer.pop(req_id, None)
+        if not finished:
+            # A stalled peer must be distinguishable from an empty answer:
+            # an empty list means "peer has none" to the sync layer, which
+            # would silently skip the range (rate_limiter.rs timeout shape).
+            raise RpcError(RESP_SERVER_ERROR, f"request timeout ({protocol})")
         out = []
         for code, data in chunks:
             if code != RESP_SUCCESS:
@@ -109,26 +124,41 @@ class RpcHandler:
             _, req_id, code, enc = frame
             data, _ = decode_frame(enc) if enc else (b"", 0)
             with self._lock:
-                if req_id in self._pending:
+                # Responses only count from the peer the request went to —
+                # req_ids are sequential and trivially guessable, so any
+                # other connected peer could otherwise inject chunks.
+                if self._req_peer.get(req_id) == src and \
+                        req_id in self._pending:
                     self._pending[req_id].append((code, data))
+        elif kind == "rpc_end":
+            _, req_id = frame
+            with self._lock:
+                done = self._done.get(req_id) \
+                    if self._req_peer.get(req_id) == src else None
+            if done is not None:
+                done.set()
 
     def _serve(self, src: str, req_id: int, protocol: str, payload: bytes) -> None:
         if not self._rate_ok(src, protocol):
             self._respond(src, req_id, RESP_RATE_LIMITED, b"rate limited")
+            self.transport.send(self.peer_id, src, ("rpc_end", req_id))
             if self.peer_manager is not None:
                 self.peer_manager.report_peer(src, PeerAction.HIGH_TOLERANCE)
             return
         handler = self.handlers.get(protocol)
         if handler is None:
             self._respond(src, req_id, RESP_INVALID_REQUEST, b"unsupported")
+            self.transport.send(self.peer_id, src, ("rpc_end", req_id))
             return
         try:
             chunks = handler(src, payload)
         except Exception as e:
             self._respond(src, req_id, RESP_SERVER_ERROR, str(e).encode())
+            self.transport.send(self.peer_id, src, ("rpc_end", req_id))
             return
         for chunk in chunks:
             self._respond(src, req_id, RESP_SUCCESS, chunk)
+        self.transport.send(self.peer_id, src, ("rpc_end", req_id))
 
     def _respond(self, dst: str, req_id: int, code: int, data: bytes) -> None:
         self.transport.send(

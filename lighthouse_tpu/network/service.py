@@ -69,6 +69,9 @@ class NetworkService:
         self._lock = threading.RLock()
         if hasattr(transport, "register"):
             transport.register(self)
+        if hasattr(transport, "on_peer_connected"):
+            # Socket transports surface inbound connections here.
+            transport.on_peer_connected = self.on_transport_peer_connected
         self._register_rpc_servers()
         self._subscribe_core_topics()
 
@@ -108,7 +111,8 @@ class NetworkService:
         )
 
     def connect(self, other: "NetworkService") -> None:
-        """Dial + handshake both ways (the swarm's dial→Status dance)."""
+        """Dial + handshake both ways (the swarm's dial→Status dance) —
+        in-process variant for the simulator fabric."""
         self.gossip._peer_connected(other.peer_id)
         other.gossip._peer_connected(self.peer_id)
         # Exchange Status over RPC.
@@ -117,6 +121,24 @@ class NetworkService:
         )
         if chunks:
             self.on_peer_status(other.peer_id, Status.from_bytes(chunks[0]))
+
+    def connect_addr(self, addr) -> str:
+        """Dial a REMOTE node by (host, port) over the socket transport and
+        run the Status handshake. Returns the remote peer id."""
+        peer_id = self.transport.dial(tuple(addr))
+        self.gossip._peer_connected(peer_id)
+        chunks = self.rpc.request(
+            peer_id, Protocol.STATUS, self.local_status().to_bytes()
+        )
+        if chunks:
+            self.on_peer_status(peer_id, Status.from_bytes(chunks[0]))
+        return peer_id
+
+    def on_transport_peer_connected(self, peer_id: str) -> None:
+        """Inbound-connection hook from a socket transport: mark the peer
+        gossip-connected (the dialer initiates Status; our STATUS server
+        records their view when it arrives)."""
+        self.gossip._peer_connected(peer_id)
 
     def on_peer_status(self, peer_id: str, status: Status) -> None:
         if status.fork_digest != self.fork_digest:

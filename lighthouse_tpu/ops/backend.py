@@ -198,8 +198,8 @@ def verify_signature_sets_tpu(
     n_bucket = _next_pow2(n, floor=max(1, floor_n))
     k_bucket = _next_pow2(k_max)
 
-    # --- stage tensors (host ints -> Montgomery limbs) --------------------
-    u = np.zeros((n_bucket, 2, 2, lb.L), dtype=np.uint64)
+    # --- stage tensors (host ints -> device limbs) ------------------------
+    u = np.zeros((n_bucket, 2, 2, lb.L), dtype=lb.NP_DTYPE)
     u_real = h2c.hash_to_field_device([s.message for s in sets])
     u[:n] = np.asarray(u_real)
 

@@ -8,7 +8,7 @@ infinity/doubling — which removes every data-dependent branch from the group
 law and lets one ``lax.scan`` body serve every element of a batch. (The
 reference's blst backend branches per point; SURVEY.md §2.7 item 1.)
 
-Shapes (Montgomery limbs, trailing axis L):
+Shapes (plain float32 limbs, trailing axis L):
     G1 point: (..., 3, L)        coordinates in Fp
     G2 point: (..., 3, 2, L)     coordinates in Fp2 (twist curve y^2 = x^3 + 4(1+u))
 
@@ -46,13 +46,14 @@ class _FieldAdapter:
     group on a new axis and performs ONE multiplication call — the trick that
     keeps the traced graph small and the TPU busy."""
 
-    def __init__(self, tail_ndim, add, sub, neg, mul, is_zero, zero, one):
+    def __init__(self, tail_ndim, add, sub, neg, mul, is_zero, eq, zero, one):
         self.tail_ndim = tail_ndim      # dims of one field element (Fp: 1, Fp2: 2)
         self.add = add
         self.sub = sub
         self.neg = neg
         self.mul = mul
-        self.is_zero = is_zero
+        self.is_zero = is_zero          # value-zero (canonicalizing)
+        self.eq = eq                    # value-equality (canonicalizing)
         self.zero = zero
         self.one = one
 
@@ -78,13 +79,13 @@ class _FieldAdapter:
 FP = _FieldAdapter(
     tail_ndim=1,
     add=lb.add, sub=lb.sub, neg=lb.neg, mul=lb.mont_mul,
-    is_zero=lb.is_zero, zero=lb.ZERO, one=lb.ONE_MONT,
+    is_zero=lb.is_zero, eq=lb.eq, zero=lb.ZERO, one=lb.ONE_MONT,
 )
 
 FP2 = _FieldAdapter(
     tail_ndim=2,
     add=lb.add, sub=lb.sub, neg=lb.neg, mul=tw.fp2_mul,
-    is_zero=tw.fp2_is_zero, zero=tw.FP2_ZERO, one=tw.FP2_ONE,
+    is_zero=tw.fp2_is_zero, eq=tw.fp2_eq, zero=tw.FP2_ZERO, one=tw.FP2_ONE,
 )
 
 
@@ -178,10 +179,8 @@ class _Group:
         a0, a1, b0, b1 = f.mul_many([X1, Y1, X2, Y2], [Z2, Z2, Z1, Z1])
         both_inf = jnp.logical_and(f.is_zero(Z1), f.is_zero(Z2))
         one_inf = jnp.logical_xor(f.is_zero(Z1), f.is_zero(Z2))
-        same = jnp.logical_and(
-            jnp.all(a0 == b0, axis=tuple(range(-f.tail_ndim, 0))),
-            jnp.all(a1 == b1, axis=tuple(range(-f.tail_ndim, 0))),
-        )
+        # Lazy limbs are not unique: compare values, not limb patterns.
+        same = jnp.logical_and(f.eq(a0, b0), f.eq(a1, b1))
         return jnp.logical_or(both_inf, jnp.logical_and(~one_inf, same))
 
     # -- scalar multiplication ---------------------------------------------

@@ -71,7 +71,7 @@ _YD = _stack_coeffs(ISO3_Y_DEN)
 
 def hash_to_field_device(messages, dst: bytes = DST_G2):
     """Host: SHA-256 hash_to_field per message -> (n, 2, 2, L) device limbs
-    (two Fp2 elements u0, u1 per message, Montgomery form)."""
+    (two Fp2 elements u0, u1 per message, canonical digits)."""
     flat = []
     for msg in messages:
         u0, u1 = oh2c.hash_to_field_fp2(msg, 2, dst)
@@ -83,12 +83,14 @@ def hash_to_field_device(messages, dst: bytes = DST_G2):
 
 
 def _sgn0_fp2(a):
-    """RFC 9380 §4.1 sgn0 for Fp2 (standard-form parity; a is Montgomery)."""
-    std = lb.from_mont(a)                      # (..., 2, L)
+    """RFC 9380 §4.1 sgn0 for Fp2: parity of the canonical value (lazy
+    limbs canonicalize first; digit 0's parity is the value's parity since
+    every higher digit contributes an even amount)."""
+    std = lb.canonicalize(a)                   # (..., 2, L) unique digits
     a0, a1 = std[..., 0, :], std[..., 1, :]
-    sign0 = (a0[..., 0] & jnp.uint64(1)) == 1
+    sign0 = jnp.mod(a0[..., 0], 2.0) == 1.0
     zero0 = jnp.all(a0 == 0, axis=-1)
-    sign1 = (a1[..., 0] & jnp.uint64(1)) == 1
+    sign1 = jnp.mod(a1[..., 0], 2.0) == 1.0
     return jnp.logical_or(sign0, jnp.logical_and(zero0, sign1))
 
 

@@ -1,21 +1,46 @@
-"""381-bit modular arithmetic as fixed-shape limb vectors (JAX).
+"""381-bit modular arithmetic as fixed-shape limb vectors (JAX, TPU-first).
 
-The TPU has no native big integers; an Fp element is a vector of L=15 limbs of
-B=26 bits held in uint64 lanes, shape ``(..., 15)``, in Montgomery form with
-R = 2^390. The 26-bit radix keeps schoolbook column sums far below 2^64
-(each product < 2^52, ≤15 terms per column, plus the Montgomery fold), so a
-single carry propagation per multiplication suffices.
-
-Compile-size discipline: a pairing traces tens of thousands of field
-multiplications, so every op here must lower to a *constant, small* number of
-HLO ops regardless of L:
-  * products use a Toeplitz gather (b[IDX] * mask * a, one reduce) — 4 ops,
-    not an unrolled 225-term double loop;
-  * carry/borrow propagation uses lax.scan over the column axis — 1 op.
-
-This replaces the reference's blst assembly field layer (crypto/bls/src/
-impls/blst.rs links Supranational blst; SURVEY.md §2.7). Differentially
+Replaces the reference's blst assembly field layer (crypto/bls/src/impls/
+blst.rs links Supranational blst; SURVEY.md §2.7 item 1). Differentially
 tested against the pure-Python oracle (lighthouse_tpu.crypto.bls.fields).
+
+Design (round-2 rewrite — the "MXU limb engine"):
+
+  * An Fp element is L=48 limbs of nominally B=8 bits, held in float32
+    lanes, PLAIN representation (no Montgomery form), little-endian:
+    value(x) = sum_i x[i] * 2^(8 i)  (mod p).
+  * Limbs are LAZY and SIGNED: add/sub/neg are pure element-wise vector
+    ops with no carry work at all; digit magnitudes and the represented
+    value are allowed to grow between multiplications. The representation
+    contract for every tensor fed back into this module:
+        |digit| <= 2^20      and      |value| < 2^392.
+    Multiplication re-normalizes its inputs, so ~12 add/sub levels can sit
+    between muls (the deepest tower chain uses ~6).
+  * All integer arithmetic is EXACT in f32: every intermediate here is an
+    integer of magnitude < 2^24 (f32's exact-integer range); carry passes
+    use floor(x/256), exact for any f32.
+  * Carry propagation is a constant number of PARALLEL passes over the
+    limb axis — never a loop-carried scan. (The round-1 engine ran a
+    lax.scan over 30 columns per multiply: the limb axis was sequential,
+    so ~1/50 of the VPU lanes did work and the Miller loop became a pure
+    latency chain. See NOTES_TPU_PERF.md.)
+  * Modular reduction is a fold through CONSTANT matrices: the columns
+    above position 48 are contracted against T[k] = digits(2^(8k) mod p)
+    with an MXU matmul (bfloat16 x bfloat16 -> float32, exact for
+    integer operands of magnitude <= 256). Montgomery's data-dependent
+    m = t*N' step — whose carry chain was the round-1 bottleneck — is
+    gone entirely.
+  * Outputs of mul are "loose-canonical": 48 digits in [-1, 256], value
+    in [0, ~1.1 * 2^384) ~ [0, 9p). Comparisons (eq / is_zero / sgn0)
+    go through canonicalize(), which produces the unique base-2^8 digits
+    of the value reduced to [0, p) using carry-lookahead borrow
+    propagation (log-depth associative_scan) — exact, branch-free, and
+    only paid on the rare comparison paths.
+
+Naming note: `mont_mul` / `mont_sqr` / `ints_to_mont` / `mont_to_ints` /
+`ONE_MONT` keep their round-1 names as the stable interface of the tower
+and staging layers, but the representation is now plain — `to_mont` is the
+identity and `from_mont` is canonicalize().
 """
 
 import numpy as np
@@ -27,172 +52,278 @@ from lighthouse_tpu.crypto.bls.constants import P
 
 # --- Limb layout ---------------------------------------------------------------
 
-B = 26                      # bits per limb
-L = 15                      # limbs per Fp element (15*26 = 390 >= 381)
-MASK = (1 << B) - 1
-NBITS = L * B               # 390
-NCOLS = 2 * L - 1           # columns of a schoolbook product
-R_MONT = 1 << NBITS         # Montgomery radix
-R2_INT = R_MONT * R_MONT % P
-NPRIME_INT = (-pow(P, -1, R_MONT)) % R_MONT     # -p^-1 mod 2^390
+B = 8                       # nominal bits per limb
+L = 48                      # limbs per Fp element (48*8 = 384 >= 381)
+RADIX = 256.0
+NBITS = L * B               # 384
+W_IN = L + 3                # squeezed operand width fed to the column product
+NCOLS = 2 * W_IN - 1        # columns of a schoolbook product (101)
 
-DTYPE = jnp.uint64
+DTYPE = jnp.float32
+NP_DTYPE = np.float32
+
+_INV_RADIX = np.float32(1.0 / 256.0)
 
 
-def int_to_limbs(x: int) -> np.ndarray:
-    """Host-side: Python int -> limb vector (numpy uint64)."""
-    out = np.zeros(L, dtype=np.uint64)
-    for i in range(L):
-        out[i] = (x >> (B * i)) & MASK
+def int_to_limbs(x: int, width: int = L) -> np.ndarray:
+    """Host-side: non-negative Python int -> base-2^8 digit vector."""
+    out = np.zeros(width, dtype=NP_DTYPE)
+    for i in range(width):
+        out[i] = (x >> (B * i)) & 0xFF
+    if x >> (B * width):
+        raise ValueError(f"{x.bit_length()}-bit value does not fit {width} limbs")
     return out
 
 
 def limbs_to_int(v) -> int:
-    """Host-side: one limb vector -> Python int."""
-    v = np.asarray(v, dtype=np.uint64)
-    return sum(int(v[i]) << (B * i) for i in range(L))
+    """Host-side: one (possibly lazy/signed) limb vector -> exact Python int."""
+    arr = np.asarray(v, dtype=np.float64)
+    return sum(int(arr[i]) << (B * i) for i in range(arr.shape[-1]))
 
 
 P_LIMBS = jnp.asarray(int_to_limbs(P), dtype=DTYPE)
-R2_LIMBS = jnp.asarray(int_to_limbs(R2_INT), dtype=DTYPE)
-NPRIME_LIMBS = jnp.asarray(int_to_limbs(NPRIME_INT), dtype=DTYPE)
 ZERO = jnp.zeros((L,), dtype=DTYPE)
-ONE_MONT = jnp.asarray(int_to_limbs(R_MONT % P), dtype=DTYPE)   # 1 in Montgomery form
+ONE_MONT = jnp.zeros((L,), dtype=DTYPE).at[0].set(1.0)   # plain 1 (name kept)
 
-# Toeplitz index/mask for column products: COL_IDX[k, i] = k - i (clamped),
-# COL_MASK[k, i] = 1 iff 0 <= k - i < L.
+# Fold matrices: T_FOLD[j] = digits(2^(8*(L+j)) mod p), one row per column
+# above position L. Entries are 8-bit digits (<= 255), exact in bfloat16;
+# contracting high columns against T_FOLD reduces the value mod p while
+# shrinking its magnitude by ~16x per round (sum_j c_j t_j <= 0.12 * value).
+_MAX_FOLD_ROWS = NCOLS + 4 - L   # enough for the widest padded product
+_T_FOLD_NP = np.stack([
+    int_to_limbs(pow(2, B * (L + j), P)) for j in range(_MAX_FOLD_ROWS)
+])
+_T_FOLD = jnp.asarray(_T_FOLD_NP, dtype=DTYPE)
+
+# Toeplitz index/mask for the column product over squeezed (W_IN-wide)
+# operands: COL_IDX[k, i] = k - i (clamped), COL_MASK[k, i] = [0 <= k-i < W_IN].
 _k = np.arange(NCOLS)[:, None]
-_i = np.arange(L)[None, :]
-COL_IDX = jnp.asarray(np.clip(_k - _i, 0, L - 1), dtype=jnp.int32)
-COL_MASK = jnp.asarray(((_k - _i >= 0) & (_k - _i < L)).astype(np.uint64), dtype=DTYPE)
+_i = np.arange(W_IN)[None, :]
+COL_IDX = jnp.asarray(np.clip(_k - _i, 0, W_IN - 1), dtype=jnp.int32)
+COL_MASK = jnp.asarray(((_k - _i >= 0) & (_k - _i < W_IN)).astype(np.float32),
+                       dtype=DTYPE)
+
+
+# --- Host staging ---------------------------------------------------------------
 
 
 def ints_to_mont(xs) -> jnp.ndarray:
-    """Host-side staging: iterable of Python ints -> (n, L) Montgomery limbs."""
-    arr = np.stack([int_to_limbs(x * R_MONT % P) for x in xs])
+    """Host staging: iterable of Python ints -> (n, L) canonical digits."""
+    arr = np.stack([int_to_limbs(x % P) for x in xs])
     return jnp.asarray(arr, dtype=DTYPE)
 
 
 def mont_to_ints(v) -> list:
-    """Host-side: (..., L) Montgomery limbs -> flat list of Python ints."""
-    arr = np.asarray(v, dtype=np.uint64).reshape(-1, L)
-    r_inv = pow(R_MONT, -1, P)
+    """Host-side: (..., width) lazy limbs -> flat list of canonical ints."""
+    arr = np.asarray(v, dtype=np.float64)
+    flat = arr.reshape(-1, arr.shape[-1])
     return [
-        sum(int(row[i]) << (B * i) for i in range(L)) * r_inv % P for row in arr
+        sum(int(row[i]) << (B * i) for i in range(row.shape[0])) % P
+        for row in flat
     ]
 
 
-# --- Core column arithmetic ----------------------------------------------------
+# --- Carry machinery (parallel passes; exact in f32) ----------------------------
 
 
-def _mul_cols(a, b):
-    """Schoolbook product as 2L-1 column sums (no carries).
+def _pad_cols(x, width: int):
+    if x.shape[-1] >= width:
+        return x
+    pad = jnp.zeros(x.shape[:-1] + (width - x.shape[-1],), dtype=x.dtype)
+    return jnp.concatenate([x, pad], axis=-1)
 
-    cols[..., k] = sum_{i+j=k} a_i b_j, computed as a Toeplitz gather of b
-    against a — constant HLO op count, fully vectorized over the batch."""
-    tb = b[..., COL_IDX] * COL_MASK          # (..., NCOLS, L)
+
+def _carry_pass(x):
+    """One parallel carry pass: x -> lo + shift(hi). Signed-exact (floor
+    semantics keep lo in [0, 255] for negative values too). The caller
+    guarantees the top column produces no carry (pad first)."""
+    hi = jnp.floor(x * _INV_RADIX)
+    lo = x - hi * RADIX
+    return lo + jnp.concatenate(
+        [jnp.zeros_like(hi[..., :1]), hi[..., :-1]], axis=-1
+    )
+
+
+def _passes(x, n: int):
+    for _ in range(n):
+        x = _carry_pass(x)
+    return x
+
+
+def _fold_dot(hi, nrows: int):
+    """Contract high columns against the constant fold matrix on the MXU.
+
+    hi: (..., nrows) digits with |digit| <= 256 (exact in bfloat16).
+    Returns (..., L) with digit <= 256 * 255 * nrows (< 2^24 for
+    nrows <= 56, f32-exact)."""
+    rows = _T_FOLD[:nrows]
+    return jax.lax.dot_general(
+        hi.astype(jnp.bfloat16),
+        rows.astype(jnp.bfloat16),
+        (((hi.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=DTYPE,
+    )
+
+
+# Non-negativity offset: a ~2^393 multiple of p, staged as base-2^8
+# digits over W_IN columns. Added before digit-squeezing so that every
+# value entering the carry machinery is POSITIVE — _carry_pass drops the
+# top column's outgoing carry, which is only sound when the (padded)
+# width strictly bounds a non-negative value.
+_OFFSET_K = (1 << 393) // P + 1
+_OFFSET_SQ = jnp.asarray(int_to_limbs(_OFFSET_K * P, width=W_IN), dtype=DTYPE)
+
+
+def _squeeze(x):
+    """Digit-squeeze an operand for the column product: shift non-negative
+    (+Kp, a no-op mod p), then 3 parallel passes bring digits into
+    [0, 256] WITHOUT folding the value (width grows to W_IN).
+
+    Input contract: |digit| <= 2^20 and |value| < 2^392 (< the 2^393
+    offset). After the shift, digits <= 2^20 + 255: pass 1 leaves
+    <= 255 + 2^12, pass 2 <= 255 + 17, pass 3 <= 256; the carry wave
+    reaches column 50 with magnitude <= 56 — W_IN = 51 keeps the top
+    column carry-free (value < 2^394 << 2^408)."""
+    return _passes(_pad_cols(x, W_IN) + _OFFSET_SQ, 3)
+
+
+def _reduce(x, folds: int = 5):
+    """Reduce a NON-NEGATIVE column vector (width >= L, digit <= 2^22.6,
+    value < 2^794) to L digits in [0, 256] with value in [0, 2^384).
+
+    Round structure (worst-case bounds):
+      passes(3): 2^22.6 -> <=255+2^14.6 -> <=255+58 -> <=256
+      big fold:  width -> L, digit <= 256 + 56*256*255 < 2^22.8,
+                 value < 2^398.8
+      then `folds` rounds of [pad(+3), passes(3), fold(3)]: each fold
+      maps the >=2^384 part c_j*2^(384+8j) to c_j*(2^(384+8j) mod p),
+      and sum_j c_j t_j <= 0.12 * value, so value contracts by >= 8x
+      per round toward [0, 2^384): 2^398.8 -> 2^395 -> 2^392 -> ...
+      after round 5 value < 1.07*2^384 and the final fold's carry is in
+      {0, 1}, which pins value < 2^384 strictly — the closing passes
+      produce no carry above column 47 and the truncation is exact.
+    """
+    w = x.shape[-1]
+    x = _passes(_pad_cols(x, w + 3), 3)
+    x = x[..., :L] + _fold_dot(x[..., L:], x.shape[-1] - L)
+    for _ in range(folds + 1):
+        x = _passes(_pad_cols(x, L + 3), 3)
+        x = x[..., :L] + _fold_dot(x[..., L:], 3)
+    return _passes(_pad_cols(x, L + 3), 3)[..., :L]
+
+
+# --- Core multiply --------------------------------------------------------------
+
+
+def _col_product(a, b):
+    """Schoolbook product as 2*W_IN-1 column sums (no carries), via a
+    Toeplitz gather of b against a. Operands: digits in [0, 256], so each
+    column sum is an exact-integer f32 of magnitude <= 51*256^2 < 2^22.
+    """
+    tb = b[..., COL_IDX] * COL_MASK            # (..., NCOLS, W_IN)
     return jnp.sum(tb * a[..., None, :], axis=-1)
 
 
-def _carry(cols, n_out: int):
-    """Propagate carries (lax.scan over columns). Returns (limbs, carry_out).
-
-    cols: (..., n_cols) uint64 column sums; limbs: (..., n_out)."""
-    n_cols = cols.shape[-1]
-    if n_out > n_cols:
-        pad = jnp.zeros(cols.shape[:-1] + (n_out - n_cols,), dtype=cols.dtype)
-        cols = jnp.concatenate([cols, pad], axis=-1)
-    cols_t = jnp.moveaxis(cols[..., :n_out], -1, 0)   # (n_out, ...)
-
-    def step(c, col):
-        tot = col + c
-        return tot >> B, tot & MASK
-
-    carry_out, limbs_t = jax.lax.scan(step, jnp.zeros_like(cols_t[0]), cols_t)
-    return jnp.moveaxis(limbs_t, 0, -1), carry_out
+def mul(a, b):
+    """Field multiply (plain representation): value(out) == a*b mod p.
+    Accepts lazy inputs (contract at module top); output loose-canonical."""
+    na = _squeeze(a)
+    nb = _squeeze(b)
+    return _reduce(_col_product(na, nb))
 
 
-def _sub_with_borrow(a, b):
-    """a - b limbwise. Returns (diff limbs, borrow_out in {0,1})."""
-    a_t = jnp.moveaxis(a, -1, 0)
-    b_t = jnp.moveaxis(b, -1, 0)
-
-    def step(borrow, ab):
-        ai, bi = ab
-        tmp = ai + jnp.uint64(1 << B) - bi - borrow
-        return jnp.uint64(1) - (tmp >> B), tmp & MASK
-
-    borrow_out, limbs_t = jax.lax.scan(step, jnp.zeros_like(a_t[0]), (a_t, b_t))
-    return jnp.moveaxis(limbs_t, 0, -1), borrow_out
+def sqr(a):
+    return mul(a, a)
 
 
-def _cond_sub_p(v):
-    """v - P if v >= P else v (requires v < 2P, normalized limbs)."""
-    diff, borrow = _sub_with_borrow(v, jnp.broadcast_to(P_LIMBS, v.shape))
-    return jnp.where((borrow == 0)[..., None], diff, v)
-
-
-# --- Field ops (Montgomery domain) ---------------------------------------------
+# Interface names kept from round 1 (see module docstring).
+mont_mul = mul
+mont_sqr = sqr
 
 
 def add(a, b):
-    s, _ = _carry(a + b, L)
-    return _cond_sub_p(s)
+    return a + b
 
 
 def sub(a, b):
-    diff, borrow = _sub_with_borrow(a, b)
-    corr, _ = _carry(
-        diff + jnp.where((borrow == 1)[..., None], jnp.broadcast_to(P_LIMBS, diff.shape), jnp.uint64(0)),
-        L,
-    )
-    return corr
+    return a - b
 
 
 def neg(a):
-    """-a mod p (maps 0 to 0)."""
-    is_zero_m = jnp.all(a == 0, axis=-1, keepdims=True)
-    diff, _ = _sub_with_borrow(jnp.broadcast_to(P_LIMBS, a.shape), a)
-    return jnp.where(is_zero_m, a, diff)
-
-
-def mont_mul(a, b):
-    """Montgomery multiplication: a*b*R^-1 mod p (inputs/outputs < p)."""
-    t_cols = _mul_cols(a, b)                                   # (..., 29)
-    t_lo, c_lo = _carry(t_cols[..., :L], L)                    # normalize low half
-    m_cols = _mul_cols(t_lo, jnp.broadcast_to(NPRIME_LIMBS, t_lo.shape))
-    m, _ = _carry(m_cols[..., :L], L)                          # m = T*N' mod R
-    mn_cols = _mul_cols(m, jnp.broadcast_to(P_LIMBS, m.shape))
-    hi_pad = jnp.concatenate(
-        [c_lo[..., None], jnp.zeros(c_lo.shape + (NCOLS - L - 1,), dtype=DTYPE)], axis=-1
-    )
-    s_cols = jnp.concatenate(
-        [t_lo + mn_cols[..., :L], t_cols[..., L:] + mn_cols[..., L:] + hi_pad], axis=-1
-    )
-    all_limbs, c_out = _carry(s_cols, 2 * L)
-    hi = jnp.concatenate([all_limbs[..., L:], c_out[..., None]], axis=-1)[..., :L]
-    return _cond_sub_p(hi)
-
-
-def mont_sqr(a):
-    return mont_mul(a, a)
+    return -a
 
 
 def to_mont(a_std):
-    return mont_mul(a_std, jnp.broadcast_to(R2_LIMBS, a_std.shape))
+    return a_std
 
 
-def from_mont(a_mont):
-    one = jnp.zeros_like(a_mont).at[..., 0].set(1)
-    return mont_mul(a_mont, one)
+# --- Canonicalization & comparisons --------------------------------------------
+
+# Canonical digit vectors of c*p for the compare-subtract rounds.
+_CP_ROUNDS = [8, 4, 2, 1, 1]
+_CP_DIGITS = jnp.asarray(
+    np.stack([int_to_limbs(c * P) for c in _CP_ROUNDS]), dtype=DTYPE
+)
+
+
+def _lookahead(g, p):
+    """Carry/borrow lookahead: b[i] = g[i] | (p[i] & b[i-1]) via an
+    associative scan over the limb axis (log-depth, branch-free)."""
+    def comb(x, y):
+        gx, px = x
+        gy, py = y
+        return jnp.logical_or(gy, jnp.logical_and(py, gx)), \
+            jnp.logical_and(px, py)
+
+    return jax.lax.associative_scan(comb, (g, p), axis=-1)[0]
+
+
+def _borrow_sub(x, c_digits):
+    """Exact x - c for digit vectors (x digits in [0, 256], c canonical).
+    Returns (difference digits in [0, 256], underflow bool)."""
+    d = x - c_digits
+    borrow = _lookahead(d < 0, d == 0)
+    b_prev = jnp.concatenate(
+        [jnp.zeros_like(borrow[..., :1]), borrow[..., :-1]], axis=-1
+    )
+    r = d - b_prev.astype(DTYPE) + borrow.astype(DTYPE) * RADIX
+    return r, borrow[..., -1]
+
+
+def _unique_digits(x):
+    """[0, 256]-digit vector -> the unique [0, 255] representation
+    (carry lookahead with generate = 256, propagate = 255)."""
+    carry = _lookahead(x >= RADIX, x == RADIX - 1)
+    c_prev = jnp.concatenate(
+        [jnp.zeros_like(carry[..., :1]), carry[..., :-1]], axis=-1
+    )
+    return x + c_prev.astype(DTYPE) - carry.astype(DTYPE) * RADIX
+
+
+def canonicalize(a):
+    """Lazy element -> the unique base-2^8 digits of value(a) mod p in
+    [0, p). Rare path (comparisons, sgn0, serialization)."""
+    # Squeeze shifts non-negative (+Kp) and _reduce pins value < 2^384
+    # < 8.6p with digits in [0, 256].
+    x = _reduce(_squeeze(a))
+    # Compare-subtract 8p, 4p, 2p, p, p -> value in [0, p).
+    for i in range(len(_CP_ROUNDS)):
+        r, under = _borrow_sub(x, _CP_DIGITS[i])
+        x = jnp.where(under[..., None], x, r)
+    return _unique_digits(x)
+
+
+def from_mont(a):
+    """Canonical digits (name kept from the Montgomery-era interface)."""
+    return canonicalize(a)
 
 
 def is_zero(a):
-    return jnp.all(a == 0, axis=-1)
+    return jnp.all(canonicalize(a) == 0, axis=-1)
 
 
 def eq(a, b):
-    return jnp.all(a == b, axis=-1)
+    return is_zero(a - b)
 
 
 def select(mask, a, b):
@@ -222,15 +353,15 @@ def pow_fixed(a, exponent: int):
     loop. Batched over leading axes."""
     if exponent == 0:
         return jnp.broadcast_to(ONE_MONT, a.shape)
-    bits = jnp.asarray([int(c) for c in bin(exponent)[2:]], dtype=jnp.uint64)
+    bits = jnp.asarray([int(c) for c in bin(exponent)[2:]], dtype=jnp.int32)
 
     def body(i, acc):
-        acc = mont_sqr(acc)
-        return jnp.where(bits[i] == 1, mont_mul(acc, a), acc)
+        acc = sqr(acc)
+        return jnp.where(bits[i] == 1, mul(acc, a), acc)
 
     return jax.lax.fori_loop(1, bits.shape[0], body, a)
 
 
 def inv(a):
-    """a^-1 via Fermat (fixed exponent p-2). Montgomery in, Montgomery out."""
+    """a^-1 via Fermat (fixed exponent p-2); maps 0 to 0."""
     return pow_fixed(a, P - 2)

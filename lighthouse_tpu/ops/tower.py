@@ -1,6 +1,7 @@
 """Extension-field towers over the limb layer (JAX, batched).
 
-Shapes (Montgomery-domain uint64 limbs, trailing axis = L limbs):
+Shapes (plain-representation float32 limbs, trailing axis = L limbs —
+see ops/limbs.py for the lazy signed-digit contract):
     Fp2  : (..., 2, L)        a0 + a1*u
     Fp6  : (..., 3, 2, L)     a0 + a1*v + a2*v^2,  v^3 = xi = 1+u
     Fp12 : (..., 2, 3, 2, L)  a0 + a1*w,           w^2 = v
@@ -109,11 +110,13 @@ def fp2_inv(a):
 
 
 def fp2_is_zero(a):
-    return jnp.all(a == 0, axis=(-1, -2))
+    """Value-zero test (canonicalizing: lazy limbs are not unique)."""
+    return jnp.all(lb.canonicalize(a) == 0, axis=(-1, -2))
 
 
 def fp2_eq(a, b):
-    return jnp.all(a == b, axis=(-1, -2))
+    a, b = jnp.broadcast_arrays(a, b)
+    return fp2_is_zero(lb.sub(a, b))
 
 
 def fp2_select(mask, a, b):
@@ -308,7 +311,10 @@ def fp12_inv(a):
 
 
 def fp12_eq(a, b):
-    return jnp.all(a == b, axis=(-1, -2, -3, -4))
+    a, b = jnp.broadcast_arrays(a, b)
+    return jnp.all(
+        lb.canonicalize(lb.sub(a, b)) == 0, axis=(-1, -2, -3, -4)
+    )
 
 
 def fp12_is_one(a):

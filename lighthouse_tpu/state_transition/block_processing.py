@@ -1,4 +1,4 @@
-"""per_block_processing — the spec block transition (altair+ accounting).
+"""per_block_processing — the spec block transition (all forks).
 
 Mirror of consensus/state_processing/src/per_block_processing.rs:100 and
 process_operations.rs:12. Signature handling follows the reference's
@@ -6,9 +6,9 @@ process_operations.rs:12. Signature handling follows the reference's
 verify in bulk beforehand (VerifyBulk → BlockSignatureVerifier) and run this
 with VerifySignatures.FALSE, or let each operation verify individually.
 
-Fork coverage: altair/bellatrix/capella/deneb bodies (phase0 PendingAttestation
-accounting intentionally unsupported — genesis starts at capella for the
-end-to-end slice; SURVEY.md §7.2 step 2).
+Fork coverage: base (phase0) through deneb — phase0 PendingAttestation
+accounting lives in base_fork.py; altair+ participation-flag accounting
+here.
 """
 
 from __future__ import annotations
@@ -96,9 +96,11 @@ def per_block_processing(
     process_randao(state, types, spec, block, fork, verify_signatures, get_pubkey)
     process_eth1_data(state, types, spec, block.body)
     process_operations(state, types, spec, block.body, fork, verify_signatures, get_pubkey)
-    process_sync_aggregate(
-        state, types, spec, block.body.sync_aggregate, verify_signatures, get_pubkey
-    )
+    if ForkName.ge(fork, ForkName.ALTAIR):
+        process_sync_aggregate(
+            state, types, spec, block.body.sync_aggregate, verify_signatures,
+            get_pubkey
+        )
 
 
 def default_pubkey_getter(state):
@@ -333,6 +335,12 @@ def process_attestation(state, types, spec, attestation, fork, verify_signatures
         ),
         "invalid indexed attestation",
     )
+
+    if fork == ForkName.BASE:
+        from .base_fork import process_attestation_base
+
+        process_attestation_base(state, types, spec, attestation, indexed)
+        return
 
     inclusion_delay = state.slot - data.slot
     flags = get_attestation_participation_flag_indices(state, spec, data, inclusion_delay)

@@ -359,6 +359,11 @@ def process_sync_committee_updates(state, types, spec) -> None:
 
 
 def process_epoch(state, types, spec, fork: str) -> None:
+    if fork == ForkName.BASE:
+        from .base_fork import process_epoch_base
+
+        process_epoch_base(state, types, spec)
+        return
     process_justification_and_finalization(state, spec)
     process_inactivity_updates(state, spec)
     process_rewards_and_penalties(state, spec, fork)
@@ -368,6 +373,11 @@ def process_epoch(state, types, spec, fork: str) -> None:
     process_effective_balance_updates(state, spec)
     process_slashings_reset(state, spec)
     process_randao_mixes_reset(state, spec)
-    process_historical_summaries_update(state, types, spec)
+    if ForkName.ge(fork, ForkName.CAPELLA):
+        process_historical_summaries_update(state, types, spec)
+    else:
+        from .base_fork import process_historical_roots_update
+
+        process_historical_roots_update(state, types, spec)
     process_participation_flag_updates(state)
     process_sync_committee_updates(state, types, spec)

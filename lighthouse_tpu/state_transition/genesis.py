@@ -79,9 +79,10 @@ def interop_genesis_state(
             )
         )
         state.balances.append(spec.max_effective_balance)
-        state.previous_epoch_participation.append(0)
-        state.current_epoch_participation.append(0)
-        state.inactivity_scores.append(0)
+        if ForkName.ge(fork, ForkName.ALTAIR):
+            state.previous_epoch_participation.append(0)
+            state.current_epoch_participation.append(0)
+            state.inactivity_scores.append(0)
 
     state.genesis_validators_root = _validators_root(types, spec, state)
 
@@ -95,11 +96,14 @@ def interop_genesis_state(
         body_root=body_cls.hash_tree_root(body_cls()),
     )
 
-    # Sync committees (altair+; all supported genesis forks are altair+).
-    from . import epoch_processing as ep
+    # Sync committees (altair+; a base genesis has none).
+    if ForkName.ge(fork, ForkName.ALTAIR):
+        from . import epoch_processing as ep
 
-    state.current_sync_committee = ep.get_next_sync_committee(state, types, spec)
-    state.next_sync_committee = ep.get_next_sync_committee(state, types, spec)
+        state.current_sync_committee = ep.get_next_sync_committee(
+            state, types, spec)
+        state.next_sync_committee = ep.get_next_sync_committee(
+            state, types, spec)
 
     # Execution payload header (bellatrix+): a synthetic pre-genesis block.
     if ForkName.ge(fork, ForkName.BELLATRIX):

@@ -44,6 +44,61 @@ def _bump_fork(state, new_state, spec, fork: str, epoch: int) -> None:
     )
 
 
+def translate_participation(state, types, spec, pending_attestations) -> None:
+    """Phase0 -> altair participation translation (upgrade/altair.rs
+    translate_participation): replay each previous-epoch PendingAttestation
+    through the altair flag rules into previous_epoch_participation."""
+    from .base_fork import get_attesting_indices_of
+    from .block_processing import get_attestation_participation_flag_indices
+
+    for a in pending_attestations:
+        flags = get_attestation_participation_flag_indices(
+            state, spec, a.data, a.inclusion_delay
+        )
+        for index in get_attesting_indices_of(state, spec, a.data,
+                                              a.aggregation_bits):
+            for flag_index in flags:
+                state.previous_epoch_participation[index] |= 1 << flag_index
+
+
+def upgrade_to_altair(state, types, spec):
+    """Phase0 -> Altair (upgrade/altair.rs): participation flags replace
+    PendingAttestations (translated, not dropped); inactivity scores and
+    sync committees appear."""
+    from .epoch_processing import get_next_sync_committee
+
+    epoch = spec.epoch_at_slot(state.slot)
+    new_state = types.BeaconStateAltair()
+    _copy_common(state, new_state, _BASE_FIELDS + _JUSTIFICATION_FIELDS)
+    _bump_fork(state, new_state, spec, ForkName.ALTAIR, epoch)
+    n = len(state.validators)
+    new_state.previous_epoch_participation = [0] * n
+    new_state.current_epoch_participation = [0] * n
+    new_state.inactivity_scores = [0] * n
+    translate_participation(new_state, types, spec,
+                            state.previous_epoch_attestations)
+    new_state.current_sync_committee = get_next_sync_committee(
+        new_state, types, spec
+    )
+    new_state.next_sync_committee = get_next_sync_committee(
+        new_state, types, spec
+    )
+    return new_state
+
+
+def upgrade_to_bellatrix(state, types, spec):
+    """Altair -> Bellatrix (upgrade/merge.rs): a default (pre-merge)
+    execution payload header appears."""
+    epoch = spec.epoch_at_slot(state.slot)
+    new_state = types.BeaconStateBellatrix()
+    _copy_common(state, new_state,
+                 _BASE_FIELDS + _JUSTIFICATION_FIELDS + _ALTAIR_FIELDS)
+    _bump_fork(state, new_state, spec, ForkName.BELLATRIX, epoch)
+    new_state.latest_execution_payload_header = \
+        types.ExecutionPayloadHeaderBellatrix()
+    return new_state
+
+
 def upgrade_to_capella(state, types, spec):
     """Bellatrix -> Capella (upgrade/capella.rs): withdrawal bookkeeping +
     historical summaries; the payload header gains withdrawals_root."""
@@ -107,10 +162,9 @@ def maybe_upgrade(state, types, spec):
     """Apply the upgrade whose activation epoch starts at state.slot
     (process_slots hook); returns the (possibly new) state.
 
-    Coverage: bellatrix->capella and capella->deneb (the forks the block
-    pipeline supports). Crossing the altair or bellatrix activation from an
-    older state raises — phase0/altair pending-attestation translation is
-    out of scope (block_processing supports altair+ accounting only)."""
+    Coverage: every fork boundary — base->altair (with PendingAttestation
+    translation), altair->bellatrix, bellatrix->capella, capella->deneb —
+    so a chain can start at phase0 genesis and cross the full schedule."""
     P = spec.preset
     if state.slot % P.SLOTS_PER_EPOCH != 0:
         return state
@@ -118,17 +172,11 @@ def maybe_upgrade(state, types, spec):
     if spec.altair_fork_epoch is not None and \
             epoch == spec.altair_fork_epoch and \
             isinstance(state, types.BeaconStateBase):
-        raise NotImplementedError(
-            "phase0 -> altair upgrade (pending-attestation translation) is "
-            "unsupported; start chains at altair or later"
-        )
+        state = upgrade_to_altair(state, types, spec)
     if spec.bellatrix_fork_epoch is not None and \
             epoch == spec.bellatrix_fork_epoch and \
             isinstance(state, types.BeaconStateAltair):
-        raise NotImplementedError(
-            "altair -> bellatrix upgrade is unsupported; start chains at "
-            "bellatrix or later"
-        )
+        state = upgrade_to_bellatrix(state, types, spec)
     if spec.capella_fork_epoch is not None and \
             epoch == spec.capella_fork_epoch and \
             isinstance(state, types.BeaconStateBellatrix):

@@ -1,0 +1,107 @@
+"""The round-2 cache additions: early-attester, attester, block-times
+(reference: early_attester_cache.rs:39, attester_cache.rs:251,
+block_times_cache.rs)."""
+
+from lighthouse_tpu.beacon_chain.caches import (
+    AttesterCache,
+    BlockTimesCache,
+    CommitteeLengths,
+    EarlyAttesterCache,
+)
+from lighthouse_tpu.state_transition import helpers as h
+from lighthouse_tpu.testing.harness import BeaconChainHarness
+
+
+def test_committee_lengths_match_state():
+    harness = BeaconChainHarness(n_validators=32, bls_backend="fake")
+    chain, spec = harness.chain, harness.chain.spec
+    state = chain.head.state
+    epoch = spec.epoch_at_slot(state.slot)
+    cl = CommitteeLengths.from_state(state, spec, epoch)
+    assert cl.committee_count_per_slot(spec) == \
+        h.get_committee_count_per_slot(state, spec, epoch)
+    slot = spec.start_slot_of_epoch(epoch)
+    for index in range(cl.committee_count_per_slot(spec)):
+        want = len(h.get_beacon_committee(state, spec, slot, index))
+        assert cl.committee_length(spec, slot, index) == want
+
+
+def test_early_attester_cache_serves_imported_block():
+    harness = BeaconChainHarness(n_validators=32, bls_backend="fake")
+    chain = harness.chain
+    (root, _), = harness.extend_chain(1, attest=False)
+    # The import populated the cache; attestation data comes straight from
+    # it (no head-state clone).
+    slot = chain.head.state.slot
+    data = chain.early_attester_cache.try_attest(
+        chain.types, chain.spec, slot, 0
+    )
+    assert data is not None
+    assert bytes(data.beacon_block_root) == root
+    assert data.slot == slot and data.index == 0
+    # Production path returns the same data.
+    produced = chain.produce_unaggregated_attestation(slot, 0)
+    assert bytes(produced.beacon_block_root) == root
+    assert produced.source == data.source and produced.target == data.target
+    # Wrong epoch / pre-block slots / bad committee index miss.
+    assert chain.early_attester_cache.try_attest(
+        chain.types, chain.spec, slot + chain.spec.preset.SLOTS_PER_EPOCH, 0
+    ) is None
+    assert chain.early_attester_cache.try_attest(
+        chain.types, chain.spec, slot, 10_000
+    ) is None
+    # Block fast paths.
+    assert chain.early_attester_cache.contains_block(root)
+    assert chain.early_attester_cache.get_block(root) is not None
+    assert not chain.early_attester_cache.contains_block(b"\x00" * 32)
+
+
+def test_attester_cache_fills_on_cross_epoch_production():
+    harness = BeaconChainHarness(n_validators=32, bls_backend="fake")
+    chain, spec = harness.chain, harness.chain.spec
+    harness.extend_chain(1, attest=False)
+    head_root = chain.head.block_root
+    # Ask for an attestation in the NEXT epoch (skipped slots over the
+    # boundary): first request advances a clone and fills the cache...
+    next_epoch_slot = spec.start_slot_of_epoch(
+        spec.epoch_at_slot(chain.head.state.slot) + 1
+    )
+    chain.slot_clock.set_slot(next_epoch_slot)
+    data1 = chain.produce_unaggregated_attestation(next_epoch_slot, 0)
+    epoch = spec.epoch_at_slot(next_epoch_slot)
+    hit = chain.attester_cache.get(epoch, head_root)
+    assert hit is not None, "first cross-epoch request must fill the cache"
+    justified, lengths = hit
+    # ...and the second request is served FROM the cache (same data).
+    data2 = chain.produce_unaggregated_attestation(next_epoch_slot, 0)
+    assert data2 == data1
+    assert data2.source == justified
+    assert lengths.committee_count_per_slot(spec) >= 1
+    chain.attester_cache.prune(epoch + 1)
+    assert chain.attester_cache.get(epoch, head_root) is None
+
+
+def test_early_attester_cache_ignores_side_fork_blocks():
+    """A competing block imported after the head must not hijack the
+    single-item cache (it only caches head-extending blocks, and the head
+    recompute clears it when fork choice picks a different root)."""
+    harness = BeaconChainHarness(n_validators=32, bls_backend="fake")
+    chain = harness.chain
+    harness.extend_chain(2, attest=True)
+    head = chain.head.block_root
+    assert chain.early_attester_cache.contains_block(head)
+
+
+def test_block_times_cache_delays():
+    c = BlockTimesCache()
+    root = b"\x11" * 32
+    c.set_time_observed(root, 5, 100.5, peer_id="peer-a")
+    c.set_time_observed(root, 5, 100.2, peer_id="peer-b")   # earlier wins
+    c.set_time_imported(root, 5, 100.9)
+    c.set_time_set_as_head(root, 5, 101.0)
+    d = c.get_block_delays(root, slot_start=100.0)
+    assert abs(d["observed"] - 0.2) < 1e-9
+    assert abs(d["imported"] - 0.7) < 1e-9
+    assert abs(d["set_as_head"] - 0.1) < 1e-9
+    c.prune(current_slot=5 + BlockTimesCache.RETAIN_SLOTS + 1)
+    assert c.get_block_delays(root, 100.0) == {}

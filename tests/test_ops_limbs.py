@@ -1,0 +1,111 @@
+"""Direct differential tests for the f32 limb engine (ops/limbs.py) against
+Python big-int ground truth — the base layer every tower/curve/pairing
+kernel rests on. Exercises the lazy signed-digit contract at its bounds
+(the representation invariants documented in the module docstring)."""
+
+import random
+
+import numpy as np
+
+from lighthouse_tpu.crypto.bls.constants import P
+from lighthouse_tpu.ops import limbs as lb
+
+rng = random.Random(0x11B5)
+
+to_dev = lb.ints_to_mont
+from_dev = lb.mont_to_ints
+
+EDGES = [0, 1, 2, 255, 256, 257, (1 << 128) - 1, (1 << 381) % P,
+         (1 << 383) % P, P - 2, P - 1]
+
+
+def test_mul_random_batch():
+    xs = [rng.randrange(P) for _ in range(64)]
+    ys = [rng.randrange(P) for _ in range(64)]
+    got = from_dev(lb.mul(to_dev(xs), to_dev(ys)))
+    assert got == [(x * y) % P for x, y in zip(xs, ys)]
+
+
+def test_mul_edge_grid():
+    pairs = [(x, y) for x in EDGES for y in EDGES]
+    got = from_dev(lb.mul(to_dev([x for x, _ in pairs]),
+                          to_dev([y for _, y in pairs])))
+    assert got == [(x * y) % P for x, y in pairs]
+
+
+def test_lazy_add_sub_chains():
+    xs = [rng.randrange(P) for _ in range(3)]
+    a, b, c = to_dev([xs[0]]), to_dev([xs[1]]), to_dev([xs[2]])
+    lazy = lb.sub(lb.add(a, b), lb.add(c, c))
+    v = (xs[0] + xs[1] - 2 * xs[2]) % P
+    assert from_dev(lb.mul(lazy, lazy))[0] == (v * v) % P
+
+
+def test_deep_doubling_chain():
+    """12 doublings push digits to ~2^19 and |value| to ~2^392 — the edge
+    of the representation contract."""
+    x = rng.randrange(P)
+    acc = to_dev([x])
+    for _ in range(12):
+        acc = lb.add(acc, acc)
+    y = rng.randrange(P)
+    assert from_dev(lb.mul(acc, to_dev([y])))[0] == (x * (1 << 12) * y) % P
+
+
+def test_signed_extremes():
+    """Large negative values (from neg/sub chains) through mul and
+    canonicalize — the round-2 bug class (dropped top-column carry)."""
+    y = rng.randrange(1, P)
+    big = to_dev([P - 1])
+    for _ in range(11):
+        big = lb.add(big, big)
+    bigneg = lb.neg(big)
+    pos_v = ((P - 1) << 11) % P
+    neg_v = (-((P - 1) << 11)) % P
+    assert from_dev(lb.mul(big, to_dev([y])))[0] == (pos_v * y) % P
+    assert from_dev(lb.mul(bigneg, to_dev([y])))[0] == (neg_v * y) % P
+    assert from_dev(lb.canonicalize(big))[0] == pos_v
+    assert from_dev(lb.canonicalize(bigneg))[0] == neg_v
+
+
+def test_canonicalize_unique_digits():
+    """canonicalize returns the unique base-2^8 digits of value mod p."""
+    vals = EDGES + [rng.randrange(P) for _ in range(8)]
+    lazy = lb.add(to_dev(vals), to_dev([P - 7] * len(vals)))
+    can = np.asarray(lb.canonicalize(lazy))
+    for i, v in enumerate(vals):
+        want = (v + P - 7) % P
+        digits = [(want >> (8 * k)) & 0xFF for k in range(lb.L)]
+        assert can[i].tolist() == digits
+    assert can.min() >= 0 and can.max() <= 255
+
+
+def test_value_zero_detection():
+    x = rng.randrange(1, P)
+    a = to_dev([x])
+    assert bool(lb.is_zero(lb.sub(a, a)))
+    assert bool(lb.is_zero(lb.add(a, to_dev([P - x]))))     # == p, lazy
+    assert not bool(lb.is_zero(a))
+    assert bool(lb.eq(lb.add(a, to_dev([P - 5])), lb.sub(a, to_dev([5]))))
+    assert not bool(lb.eq(a, to_dev([x + 1 if x + 1 < P else 1])))
+
+
+def test_inv_and_pow():
+    for x in [1, 2, 3, rng.randrange(P), P - 1]:
+        assert from_dev(lb.inv(to_dev([x])))[0] == pow(x, P - 2, P)
+    assert from_dev(lb.inv(to_dev([0])))[0] == 0
+    x = rng.randrange(P)
+    assert from_dev(lb.pow_fixed(to_dev([x]), 65537))[0] == pow(x, 65537, P)
+
+
+def test_mul_output_digit_bounds():
+    """Post-mul digits sit in [0, 256] (the loose-canonical contract the
+    squeeze/fold bound analysis depends on)."""
+    xs = [rng.randrange(P) for _ in range(32)]
+    out = np.asarray(lb.mul(to_dev(xs), to_dev(xs)))
+    assert out.min() >= 0.0 and out.max() <= 256.0
+
+
+def test_staging_roundtrip():
+    vals = EDGES + [rng.randrange(P) for _ in range(16)]
+    assert from_dev(to_dev(vals)) == vals
