@@ -243,15 +243,14 @@ class BeaconChain:
     def process_block(self, signed_block) -> bytes:
         """Full import pipeline; returns the block root
         (beacon_chain.rs:2982 process_block)."""
-        self.block_times_cache.set_time_observed(
-            self.types.BeaconBlock[
-                self.fork_at(signed_block.message.slot)
-            ].hash_tree_root(signed_block.message),
-            signed_block.message.slot,
-            self.slot_clock._now_seconds(),
-        )
+        t_observed = self.slot_clock._now_seconds()
         with self._lock:
             gossip = blk_ver.gossip_verify_block(self, signed_block)
+            # Delay forensics: stamp arrival using the root the gossip
+            # pipeline just computed (no extra merkleization).
+            self.block_times_cache.set_time_observed(
+                gossip.block_root, signed_block.message.slot, t_observed
+            )
             sig = blk_ver.signature_verify_block(self, gossip)
             pending = blk_ver.into_execution_pending_block(self, sig)
             root = self.import_block(pending)
@@ -488,19 +487,15 @@ class BeaconChain:
             if hit is not None:
                 justified, lengths = hit
                 if committee_index < lengths.committee_count_per_slot(spec):
-                    target_start = spec.start_slot_of_epoch(epoch)
-                    if target_start <= head_state.slot:
-                        target_root = h.get_block_root_at_slot(
-                            head_state, spec, target_start
-                        )
-                    else:
-                        target_root = self.head.block_root
+                    # epoch > head epoch implies the target epoch's start
+                    # slot is past the head: the head IS the target root.
                     return t.AttestationData(
                         slot=slot,
                         index=committee_index,
                         beacon_block_root=self.head.block_root,
                         source=justified,
-                        target=t.Checkpoint(epoch=epoch, root=target_root),
+                        target=t.Checkpoint(epoch=epoch,
+                                            root=self.head.block_root),
                     )
         state = self.head_state_clone_at(slot)
         if epoch > spec.epoch_at_slot(head_state.slot):

@@ -128,6 +128,16 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return buf
 
 
+def _decompress_capped(body: bytes) -> bytes:
+    """zlib with a DECODED-size cap — the length prefix only bounds the
+    compressed size, and a decompression bomb must not OOM the node."""
+    d = zlib.decompressobj()
+    out = d.decompress(body, MAX_FRAME)
+    if d.unconsumed_tail or (d.decompress(b"", 1) != b""):
+        raise ValueError("frame decompresses over the size cap")
+    return out
+
+
 def _recv_msg(sock: socket.socket):
     hdr = _recv_exact(sock, 4)
     if hdr is None:
@@ -138,7 +148,7 @@ def _recv_msg(sock: socket.socket):
     body = _recv_exact(sock, n)
     if body is None:
         return None
-    return decode_wire(zlib.decompress(body))
+    return decode_wire(_decompress_capped(body))
 
 
 # --- TCP transport ----------------------------------------------------------
@@ -153,6 +163,7 @@ class TcpTransport:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.node = None
         self._conns: Dict[str, socket.socket] = {}
+        self._send_locks: Dict[str, threading.Lock] = {}
         self._conn_lock = threading.Lock()
         self._peer_addrs: Dict[str, Tuple[str, int]] = {}
         self.on_peer_connected: Optional[Callable[[str], None]] = None
@@ -217,7 +228,7 @@ class TcpTransport:
                                 self.listen_addr[0], self.listen_addr[1])))
             sock.settimeout(None)
             self._add_conn(remote_id, sock, (rhost, rport))
-        except (OSError, ValueError, zlib.error):
+        except (OSError, ValueError, zlib.error, struct.error, IndexError):
             # Garbage hellos (port scanners, bad peers) must not leak the
             # socket or kill the handshake thread.
             try:
@@ -255,7 +266,7 @@ class TcpTransport:
                             self.node.handle_frame(src, frame)
                         except Exception:
                             pass  # a bad frame must not kill the reader
-        except (OSError, ValueError, zlib.error):
+        except (OSError, ValueError, zlib.error, struct.error, IndexError):
             pass
         finally:
             with self._conn_lock:
@@ -271,10 +282,15 @@ class TcpTransport:
     def send(self, src: str, dst: str, frame: tuple) -> None:
         with self._conn_lock:
             sock = self._conns.get(dst)
+            lock = self._send_locks.setdefault(dst, threading.Lock())
         if sock is None:
             return  # disconnected peer: frames drop, like an unreachable host
         try:
-            sock.sendall(_pack(("frame", src, frame)))
+            # sendall of a large frame is not atomic: concurrent writers
+            # (RPC responder + gossip publisher) must not interleave bytes
+            # inside the length-prefixed stream.
+            with lock:
+                sock.sendall(_pack(("frame", src, frame)))
         except OSError:
             with self._conn_lock:
                 if self._conns.get(dst) is sock:
@@ -353,8 +369,8 @@ class UdpTransport:
             except OSError:
                 return
             try:
-                msg = decode_wire(zlib.decompress(data))
-            except (ValueError, zlib.error):
+                msg = decode_wire(_decompress_capped(data))
+            except (ValueError, zlib.error, struct.error, IndexError):
                 continue
             if not (isinstance(msg, tuple) and len(msg) == 5
                     and msg[0] == "pkt"):

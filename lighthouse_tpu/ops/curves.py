@@ -186,22 +186,37 @@ class _Group:
     # -- scalar multiplication ---------------------------------------------
 
     def mul_fixed_scalar(self, p, k: int):
-        """[k]p for a compile-time scalar, MSB-first double-and-add via scan
-        (one traced body regardless of bit length)."""
+        """[k]p for a compile-time scalar, MSB-first and SEGMENTED: runs of
+        zero bits become one doubles-only lax.scan and each one-bit an
+        unrolled add — a hamming-weight-w n-bit scalar costs n doubles +
+        w adds instead of n (double + add + select). The BLS x (weight 6)
+        drops from 64 combined steps to 64 doubles + 6 adds — the
+        cofactor-clearing / subgroup-check hot path."""
         if k < 0:
             return self.mul_fixed_scalar(self.neg(p), -k)
         if k == 0:
             return jnp.broadcast_to(self.infinity, p.shape)
-        bits = jnp.asarray([int(c) for c in bin(k)[2:]], dtype=jnp.uint8)
+        bits = bin(k)[2:]
 
-        def step(acc, bit):
-            acc = self.double(acc)
-            with_add = self.add(acc, p)
-            cond = jnp.broadcast_to(bit == 1, acc.shape[: acc.ndim - self.f.tail_ndim - 1])
-            return self.select(cond, with_add, acc), None
+        def dbl_body(acc, _):
+            return self.double(acc), None
 
-        init = jnp.broadcast_to(self.infinity, p.shape)
-        acc, _ = jax.lax.scan(step, init, bits)
+        acc = jnp.broadcast_to(p, p.shape)
+        i = 1
+        while i < len(bits):
+            j = i
+            while j < len(bits) and bits[j] == "0":
+                j += 1
+            run = j - i                      # zero-run doubles
+            if j < len(bits):
+                run += 1                     # the double before the add
+            if run == 1:
+                acc = self.double(acc)
+            elif run > 1:
+                acc, _ = jax.lax.scan(dbl_body, acc, None, length=run)
+            if j < len(bits):
+                acc = self.add(acc, p)
+            i = j + 1
         return acc
 
     def mul_var_scalar(self, p, k, nbits: int = 64):

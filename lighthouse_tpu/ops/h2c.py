@@ -64,6 +64,10 @@ _XN = _stack_coeffs(ISO3_X_NUM)
 _XD = _stack_coeffs(ISO3_X_DEN)
 _YN = _stack_coeffs(ISO3_Y_NUM)
 _YD = _stack_coeffs(ISO3_Y_DEN)
+# x_den (a quadratic) homogenized into the cubic power basis
+# [xd^3, xn*xd^2, xn^2*xd, xn^3]: one implicit extra xd factor, top
+# coefficient zero.
+_XD_H = _stack_coeffs(list(ISO3_X_DEN) + [(0, 0)])
 
 
 # --- Host staging ----------------------------------------------------------
@@ -94,75 +98,104 @@ def _sgn0_fp2(a):
     return jnp.logical_or(sign0, jnp.logical_and(zero0, sign1))
 
 
-def map_to_curve_sswu(u):
-    """Batched simplified SWU: u (..., 2, L) -> affine point on E2' (iso
-    curve), shape (..., 2, 2, L). Mirrors the oracle's branches
-    (hash_to_curve.py:59-83) as masked selects."""
-    zu2 = tw.fp2_mul(jnp.broadcast_to(_Z, u.shape), tw.fp2_sqr(u))
-    tv = lb.add(tw.fp2_sqr(zu2), zu2)
-    tv_zero = tw.fp2_is_zero(tv)
-    # 1/tv with tv=0 mapped safely (result unused under the mask).
-    tv_inv = tw.fp2_inv(tw.fp2_select(tv_zero, jnp.broadcast_to(tw.FP2_ONE, tv.shape), tv))
-    x1_main = tw.fp2_mul(
-        jnp.broadcast_to(_MINUS_B_OVER_A, u.shape),
-        lb.add(jnp.broadcast_to(tw.FP2_ONE, tv_inv.shape), tv_inv),
+def map_to_curve_sswu_projective(u):
+    """Batched simplified SWU, PROJECTIVE x and no field inversion
+    (RFC 9380 Appendix F.2 straight-line form): u (..., 2, L) ->
+    (x_num, x_den, y) with the curve point (x_num/x_den, y) on E2'.
+
+    One fp2_sqrt_ratio exponentiation replaces the round-1 map's
+    fp2_inv + two fp2_sqrt exponentiations (~5x fewer field muls);
+    the exceptional tv2 = 0 case folds into the denominator CMOV
+    (x1 = B/(Z*A)), exactly the RFC's tv4 = CMOV(Z, -tv2, tv2 != 0)."""
+    tv1 = tw.fp2_mul(jnp.broadcast_to(_Z, u.shape), tw.fp2_sqr(u))  # Z u^2
+    tv2 = lb.add(tw.fp2_sqr(tv1), tv1)             # Z^2 u^4 + Z u^2
+    tv2_zero = tw.fp2_is_zero(tv2)
+    one = jnp.broadcast_to(tw.FP2_ONE, tv2.shape)
+    xn = tw.fp2_mul(jnp.broadcast_to(_B, tv2.shape), lb.add(tv2, one))
+    den_inner = tw.fp2_select(
+        tv2_zero, jnp.broadcast_to(_Z, tv2.shape), lb.neg(tv2)
     )
-    x1 = tw.fp2_select(tv_zero, jnp.broadcast_to(_X1_EXC, x1_main.shape), x1_main)
+    xd = tw.fp2_mul(jnp.broadcast_to(_A, tv2.shape), den_inner)  # nonzero
 
-    def gx(x):
-        # x^3 + A x + B
-        x2 = tw.fp2_sqr(x)
-        m = tw.fp2_mul(
-            jnp.stack([x2, jnp.broadcast_to(_A, x.shape)], axis=-3),
-            jnp.stack([x, x], axis=-3),
-        )
-        return lb.add(lb.add(m[..., 0, :, :], m[..., 1, :, :]), jnp.broadcast_to(_B, x.shape))
+    # gx = (xn^3 + A xn xd^2 + B xd^3) / xd^3
+    sq = tw.fp2_sqr(jnp.stack([xn, xd], axis=-3))
+    xn2, xd2 = sq[..., 0, :, :], sq[..., 1, :, :]
+    m = tw.fp2_mul(
+        jnp.stack([xn2, xd2, xd2], axis=-3),
+        jnp.stack([xn, xd, xn], axis=-3),
+    )
+    xn3, xd3, xnxd2 = m[..., 0, :, :], m[..., 1, :, :], m[..., 2, :, :]
+    m2 = tw.fp2_mul(
+        jnp.stack([xnxd2, xd3], axis=-3),
+        jnp.stack([jnp.broadcast_to(_A, xd3.shape),
+                   jnp.broadcast_to(_B, xd3.shape)], axis=-3),
+    )
+    gxn = lb.add(lb.add(xn3, m2[..., 0, :, :]), m2[..., 1, :, :])
+    is_sq, y1 = tw.fp2_sqrt_ratio(gxn, xd3)
 
-    gx1 = gx(x1)
-    y1, ok1 = tw.fp2_sqrt(gx1)
-    x2 = tw.fp2_mul(zu2, x1)
-    gx2 = gx(x2)
-    y2, _ok2 = tw.fp2_sqrt(gx2)
-
-    x = tw.fp2_select(ok1, x1, x2)
-    y = tw.fp2_select(ok1, y1, y2)
-    # Sign fix: sgn0(u) == sgn0(y), else negate y.
+    # Non-square branch: x2 = tv1 * x1 (same denominator), y2 = tv1*u*y1
+    # (uses gx2 = Z^3 u^6 gx1 and y1^2 = Z*gx1 there).
+    m3 = tw.fp2_mul(
+        jnp.stack([tv1, tw.fp2_mul(tv1, u)], axis=-3),
+        jnp.stack([xn, y1], axis=-3),
+    )
+    x2n, y2 = m3[..., 0, :, :], m3[..., 1, :, :]
+    xn_out = tw.fp2_select(is_sq, xn, x2n)
+    y = tw.fp2_select(is_sq, y1, y2)
     flip = jnp.logical_xor(_sgn0_fp2(u), _sgn0_fp2(y))
     y = tw.fp2_select(flip, lb.neg(y), y)
-    return jnp.stack([x, y], axis=-3)
+    return xn_out, xd, y
 
 
-def _horner(coeffs, x):
-    """Evaluate sum coeffs[i] x^i with constant Fp2 coeffs (batched x)."""
-    acc = jnp.broadcast_to(coeffs[-1], x.shape)
-    for i in range(coeffs.shape[0] - 2, -1, -1):
-        acc = lb.add(tw.fp2_mul(acc, x), jnp.broadcast_to(coeffs[i], x.shape))
-    return acc
-
-
-def iso_map_projective(pt):
-    """3-isogeny E2' -> E2 (RFC 9380 App. E.3), emitting a PROJECTIVE point:
-    (x_num*y_den, y*y_num*x_den, x_den*y_den). The kernel (x_den = 0) lands
-    on (_, _, 0) = infinity — branch-free, unlike the oracle's None return
-    (hash_to_curve.py:102-103)."""
-    x = pt[..., 0, :, :]
-    y = pt[..., 1, :, :]
-    xn, xd, yn, yd = _horner(_XN, x), _horner(_XD, x), _horner(_YN, x), _horner(_YD, x)
+def iso_map_homogeneous(xn, xd, y):
+    """3-isogeny E2' -> E2 (RFC 9380 App. E.3) on a PROJECTIVE x: with
+    x = xn/xd, evaluate the four isogeny polynomials homogenized to
+    degree 3 (x_num/y_num/y_den are cubics, x_den is a quadratic times
+    one extra xd), then emit the projective point
+    (x_num*y_den, y*y_num*x_den, x_den*y_den) — the kernel maps to
+    infinity branch-free."""
+    sq = tw.fp2_sqr(jnp.stack([xn, xd], axis=-3))
+    xn2, xd2 = sq[..., 0, :, :], sq[..., 1, :, :]
     m = tw.fp2_mul(
-        jnp.stack([xn, yn, xd], axis=-3),
-        jnp.stack([yd, y, yd], axis=-3),
+        jnp.stack([xn2, xd2, xn2], axis=-3),
+        jnp.stack([xn, xd, xd], axis=-3),
     )
-    X = m[..., 0, :, :]
-    yyn = m[..., 1, :, :]
-    Z = m[..., 2, :, :]
-    Y = tw.fp2_mul(yyn, xd)
+    xn3, xd3, xn2xd = m[..., 0, :, :], m[..., 1, :, :], m[..., 2, :, :]
+    xnxd2 = tw.fp2_mul(xn, xd2)
+    # Power basis for degree-3 homogenization: [xd^3, xn*xd^2, xn^2*xd, xn^3]
+    basis = jnp.stack([xd3, xnxd2, xn2xd, xn3], axis=-3)
+
+    def hom_eval(coeffs):
+        # sum coeffs[i] * xn^i * xd^(3-i) — one stacked constant multiply.
+        shape = basis.shape
+        prod = tw.fp2_mul(jnp.broadcast_to(coeffs, shape), basis)
+        acc = prod[..., 0, :, :]
+        for i in range(1, coeffs.shape[0]):
+            acc = lb.add(acc, prod[..., i, :, :])
+        return acc
+
+    # x_den is degree 2: homogenize with xd^(2-i) then multiply by xd
+    # (equivalently use basis[1:] which carries one extra xd factor each).
+    xnum = hom_eval(_XN)
+    xden = hom_eval(_XD_H)
+    ynum = hom_eval(_YN)
+    yden = hom_eval(_YD)
+    m2 = tw.fp2_mul(
+        jnp.stack([xnum, ynum, xden], axis=-3),
+        jnp.stack([yden, y, yden], axis=-3),
+    )
+    X = m2[..., 0, :, :]
+    yyn = m2[..., 1, :, :]
+    Z = m2[..., 2, :, :]
+    Y = tw.fp2_mul(yyn, xden)
     return cv.G2.pack(X, Y, Z)
 
 
 def hash_to_g2_device(u):
     """Device: (n, 2, 2, L) field elements (u0, u1 per message) -> (n, 3, 2, L)
     projective G2 points. Full map: SSWU x2, isogeny, add, clear cofactor."""
-    q = iso_map_projective(map_to_curve_sswu(u))       # (n, 2, 3, 2, L)
+    xn, xd, y = map_to_curve_sswu_projective(u)        # (n, 2, ...) pair axis
+    q = iso_map_homogeneous(xn, xd, y)                 # (n, 2, 3, 2, L)
     s = cv.G2.add(q[..., 0, :, :, :], q[..., 1, :, :, :])
     return cv.g2_clear_cofactor(s)
 

@@ -187,29 +187,42 @@ def _squeeze(x):
     return _passes(_pad_cols(x, W_IN) + _OFFSET_SQ, 3)
 
 
+def _fold_small(x, nrows: int):
+    """Small-round fold on the VPU in f32 (exact: digits <= 2^10, rows
+    <= 255, products < 2^18). Unlike _fold_dot there is no bfloat16
+    range constraint, so the feeding carry chain only needs 2 passes."""
+    out = x[..., :L]
+    for j in range(nrows):
+        out = out + x[..., L + j, None] * _T_FOLD[j]
+    return out
+
+
 def _reduce(x, folds: int = 5):
     """Reduce a NON-NEGATIVE column vector (width >= L, digit <= 2^22.6,
-    value < 2^794) to L digits in [0, 256] with value in [0, 2^384).
+    value < 2^794) to L digits in [0, 259) with value in [0, 2^384).
 
     Round structure (worst-case bounds):
       passes(3): 2^22.6 -> <=255+2^14.6 -> <=255+58 -> <=256
-      big fold:  width -> L, digit <= 256 + 56*256*255 < 2^22.8,
-                 value < 2^398.8
-      then `folds` rounds of [pad(+3), passes(3), fold(3)]: each fold
-      maps the >=2^384 part c_j*2^(384+8j) to c_j*(2^(384+8j) mod p),
-      and sum_j c_j t_j <= 0.12 * value, so value contracts by >= 8x
-      per round toward [0, 2^384): 2^398.8 -> 2^395 -> 2^392 -> ...
-      after round 5 value < 1.07*2^384 and the final fold's carry is in
-      {0, 1}, which pins value < 2^384 strictly — the closing passes
-      produce no carry above column 47 and the truncation is exact.
+      big fold:  width -> L (MXU, bf16-exact inputs <= 256), digit
+                 <= 256 + 56*256*255 < 2^22.8, value < 2^398.8
+      then `folds` rounds of [pad(+3), passes(2), fold(3) on the VPU]:
+      each fold maps the >=2^384 part c_j*2^(384+8j) to
+      c_j*(2^(384+8j) mod p), and sum_j c_j t_j <= 0.12 * value, so the
+      value contracts by >= 8x per round toward [0, 2^384): 2^398.8 ->
+      2^395 -> 2^391 -> 2^387 -> 1.1*2^384 -> < 2^384 strictly after
+      round 5 — the closing passes produce no carry above column 47 and
+      the truncation is exact. Digits after a 2-pass round are <= 258
+      (255 + carry 3), f32-exact for every consumer (the next squeeze
+      re-normalizes; only the MXU fold needs <= 256, and it only ever
+      sees 3-pass-normalized input).
     """
     w = x.shape[-1]
     x = _passes(_pad_cols(x, w + 3), 3)
     x = x[..., :L] + _fold_dot(x[..., L:], x.shape[-1] - L)
-    for _ in range(folds + 1):
-        x = _passes(_pad_cols(x, L + 3), 3)
-        x = x[..., :L] + _fold_dot(x[..., L:], 3)
-    return _passes(_pad_cols(x, L + 3), 3)[..., :L]
+    for _ in range(folds):
+        x = _passes(_pad_cols(x, L + 3), 2)
+        x = _fold_small(x, 3)
+    return _passes(_pad_cols(x, L + 3), 2)[..., :L]
 
 
 # --- Core multiply --------------------------------------------------------------
@@ -233,7 +246,10 @@ def mul(a, b):
 
 
 def sqr(a):
-    return mul(a, a)
+    """Squaring: one squeeze instead of two (the column product reuses
+    the normalized operand)."""
+    na = _squeeze(a)
+    return _reduce(_col_product(na, na))
 
 
 # Interface names kept from round 1 (see module docstring).
@@ -349,17 +365,38 @@ def tree_reduce(vals, combine, identity, axis_size: int):
 
 
 def pow_fixed(a, exponent: int):
-    """a^exponent for a fixed (compile-time) exponent via an MSB-first bit
-    loop. Batched over leading axes."""
+    """a^exponent for a fixed (compile-time) exponent, 4-bit windowed
+    (n sqr + n/4 table muls in ONE scan body — see tower.fp2_pow_fixed
+    for the compile-size rationale). Batched over leading axes."""
     if exponent == 0:
         return jnp.broadcast_to(ONE_MONT, a.shape)
-    bits = jnp.asarray([int(c) for c in bin(exponent)[2:]], dtype=jnp.int32)
+    if exponent < 16:
+        acc = a
+        for c in bin(exponent)[3:]:
+            acc = sqr(acc)
+            if c == "1":
+                acc = mul(acc, a)
+        return acc
+    digits = []
+    e = exponent
+    while e:
+        digits.append(e & 15)
+        e >>= 4
+    digits = digits[::-1]
 
-    def body(i, acc):
-        acc = sqr(acc)
-        return jnp.where(bits[i] == 1, mul(acc, a), acc)
+    pows = [jnp.broadcast_to(ONE_MONT, a.shape), a, sqr(a)]
+    for _ in range(13):
+        pows.append(mul(pows[-1], a))
+    table = jnp.stack(pows, axis=0)
 
-    return jax.lax.fori_loop(1, bits.shape[0], body, a)
+    def body(acc, digit):
+        acc = sqr(sqr(sqr(sqr(acc))))
+        return mul(acc, table[digit]), None
+
+    init = table[digits[0]]
+    ds = jnp.asarray(digits[1:], dtype=jnp.int32)
+    acc, _ = jax.lax.scan(body, init, ds)
+    return acc
 
 
 def inv(a):

@@ -179,27 +179,66 @@ def miller_loop(p_aff, q_aff):
 # Final exponentiation
 # ---------------------------------------------------------------------------
 
-_HARD_BITS = jnp.asarray([int(c) for c in bin(_HARD_EXP)[2:]], dtype=jnp.uint8)
+# Hard-part decomposition (verified exactly at import): with the BLS
+# parameter x (negative) and e = (x-1)^2 / 3,
+#     (p^4 - p^2 + 1)/r  =  e * (x + p) * (x^2 + p^2 - 1)  +  1
+# so the 1270-bit square-and-multiply collapses into one 126-bit and three
+# 64-bit exponentiations plus Frobenius maps and a handful of Fp12 muls
+# (~6x fewer multiplications; the structure the reference's blst realizes
+# with its x-chain final exponentiation). After the easy part the value is
+# CYCLOTOMIC, so inversion is conjugation and x < 0 costs one conj.
+_X = -BLS_X_ABS
+_E_EXP = (_X - 1) ** 2 // 3
+assert _E_EXP * (_X + P) * (_X * _X + P * P - 1) + 1 == _HARD_EXP
+assert (_X - 1) ** 2 % 3 == 0
+
+
+def _fp12_pow_abs(f, k: int):
+    """f^k for a fixed positive scalar, segmented: zero-bit runs become one
+    fp12_sqr-only scan, one-bits unrolled muls (mirrors
+    curves.mul_fixed_scalar)."""
+    bits = bin(k)[2:]
+
+    def sqr_body(acc, _):
+        return tw.fp12_sqr(acc), None
+
+    acc = f
+    i = 1
+    while i < len(bits):
+        j = i
+        while j < len(bits) and bits[j] == "0":
+            j += 1
+        run = (j - i) + (1 if j < len(bits) else 0)
+        if run == 1:
+            acc = tw.fp12_sqr(acc)
+        elif run > 1:
+            acc, _ = jax.lax.scan(sqr_body, acc, None, length=run)
+        if j < len(bits):
+            acc = tw.fp12_mul(acc, f)
+        i = j + 1
+    return acc
 
 
 def final_exponentiation(f):
     """f -> f^((p^12 - 1)/r), bit-exact with the oracle.
 
     Easy part: f^(p^6-1) = conj(f) * f^-1 (one tower inversion), then
-    ^(p^2+1) via Frobenius. Hard part: MSB-first square-and-multiply scan
-    over the exact exponent (p^4 - p^2 + 1)/r — one scan body regardless of
-    the 1270-bit length. (Cyclotomic-squaring chains are a later
-    optimization; this runs once per verification batch.)
+    ^(p^2+1) via Frobenius. Hard part: the x-chain decomposition above.
     """
     t = tw.fp12_mul(tw.fp12_conj(f), tw.fp12_inv(f))
     t = tw.fp12_mul(tw.fp12_frob_n(t, 2), t)
 
-    def body(acc, bit):
-        acc = tw.fp12_sqr(acc)
-        return jnp.where(bit == 1, tw.fp12_mul(acc, t), acc), None
-
-    acc, _ = jax.lax.scan(body, t, _HARD_BITS[1:])
-    return acc
+    g1 = _fp12_pow_abs(t, _E_EXP)                       # t^e
+    # g1^(x+p) = conj(g1^|x|) * frob(g1)     (x negative, g1 cyclotomic)
+    g2 = tw.fp12_mul(
+        tw.fp12_conj(_fp12_pow_abs(g1, BLS_X_ABS)), tw.fp12_frob(g1)
+    )
+    # g2^(x^2+p^2-1) = (g2^|x|)^|x| * frob^2(g2) * conj(g2)
+    g2x2 = _fp12_pow_abs(_fp12_pow_abs(g2, BLS_X_ABS), BLS_X_ABS)
+    g3 = tw.fp12_mul(
+        tw.fp12_mul(g2x2, tw.fp12_frob_n(g2, 2)), tw.fp12_conj(g2)
+    )
+    return tw.fp12_mul(g3, t)
 
 
 # ---------------------------------------------------------------------------

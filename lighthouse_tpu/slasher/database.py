@@ -6,24 +6,19 @@ attestation records in LMDB or MDBX behind a backend trait
 `SlasherBackend`; the disk backend rides the same native C++ kvstore as the
 hot/cold store, persisting:
 
-  * min/max-target matrices as (validator-chunk, epoch-window) tiles of
-    256 validators x the full history row — the array.rs chunking idea with
-    the epoch axis kept whole (it is bounded by history_length);
-  * attestation records as SSZ under (validator, source, target) keys.
-
-`Slasher.open(backend, types)` restores state; `Slasher.flush()` writes
-dirty validator chunks + new records. Epoch windows prune with the in-memory
-maps.
+  * min/max-target matrices as zlib-compressed 256-validator x 16-epoch
+    uint16 DISTANCE tiles (array.rs Chunk layout), written by
+    slasher.TargetArray's write-back cache;
+  * attestation records as data_root || SSZ under target-first
+    (target, validator, source) keys — range-prunable and seekable by
+    (target, validator) for conflicting-attestation retrieval
+    (SlasherDB::get_attestation_for_validator).
 """
 
 from __future__ import annotations
 
 import struct
 from typing import Dict, Iterator, List, Optional, Tuple
-
-import numpy as np
-
-_CHUNK_VALIDATORS = 256
 
 _COL_MIN = "smn"
 _COL_MAX = "smx"
@@ -46,25 +41,61 @@ class SlasherBackend:
     def iter_column(self, column: str) -> Iterator[Tuple[bytes, bytes]]:
         raise NotImplementedError
 
+    def iter_column_from(self, column: str,
+                         start_key: bytes) -> Iterator[Tuple[bytes, bytes]]:
+        """Ordered (key, value) with key >= start_key (seek)."""
+        for k, v in self.iter_column(column):
+            if k >= start_key:
+                yield k, v
+
     def close(self) -> None:
         pass
 
 
 class MemorySlasherBackend(SlasherBackend):
+    """Dict store with a bisect-sorted key index per column (seeks are
+    O(log n), matching the disk backend's ordered iterators)."""
+
     def __init__(self):
         self._data: Dict[str, Dict[bytes, bytes]] = {}
+        self._keys: Dict[str, list] = {}
 
     def put(self, column, key, value):
-        self._data.setdefault(column, {})[bytes(key)] = bytes(value)
+        import bisect
+
+        key = bytes(key)
+        col = self._data.setdefault(column, {})
+        if key not in col:
+            bisect.insort(self._keys.setdefault(column, []), key)
+        col[key] = bytes(value)
 
     def get(self, column, key):
         return self._data.get(column, {}).get(bytes(key))
 
     def delete(self, column, key):
-        self._data.get(column, {}).pop(bytes(key), None)
+        key = bytes(key)
+        col = self._data.get(column, {})
+        if key in col:
+            del col[key]
+            ks = self._keys.get(column, [])
+            import bisect
+
+            i = bisect.bisect_left(ks, key)
+            if i < len(ks) and ks[i] == key:
+                ks.pop(i)
 
     def iter_column(self, column):
-        yield from sorted(self._data.get(column, {}).items())
+        col = self._data.get(column, {})
+        for k in list(self._keys.get(column, [])):
+            yield k, col[k]
+
+    def iter_column_from(self, column, start_key):
+        import bisect
+
+        col = self._data.get(column, {})
+        ks = self._keys.get(column, [])
+        for i in range(bisect.bisect_left(ks, bytes(start_key)), len(ks)):
+            yield ks[i], col[ks[i]]
 
 
 class DiskSlasherBackend(SlasherBackend):
@@ -87,14 +118,16 @@ class DiskSlasherBackend(SlasherBackend):
     def iter_column(self, column):
         yield from self._db.iter_column_from(column)
 
+    def iter_column_from(self, column, start_key):
+        yield from self._db.iter_column_from(column, start_key)
+
     def close(self):
         self._db.close()
 
 
 def _rec_key(v: int, source: int, target: int) -> bytes:
     # TARGET-first (big-endian): the sorted column iterates in epoch order,
-    # so window pruning is a prefix range scan with early exit — the
-    # reference's epoch-windowed DB layout for exactly this reason.
+    # so pruning is a prefix scan and (target, validator) lookups are seeks.
     return struct.pack(">QQQ", target, v, source)
 
 
@@ -104,80 +137,82 @@ def _unrec_key(k: bytes) -> Tuple[int, int, int]:
 
 
 class SlasherPersistence:
-    """Glue between a Slasher's in-memory state and a backend."""
+    """Record + metadata store between a Slasher and a backend (the chunk
+    arrays talk to the backend directly via slasher.TargetArray)."""
 
     def __init__(self, backend: SlasherBackend, types):
         self.backend = backend
         self.types = types
-        self._dirty_chunks: set = set()
-        self._new_records: List[Tuple[int, int, int, object]] = []
+        # queued (v, source, target, data_root, att) awaiting flush, plus a
+        # (v, target) index so double-vote checks stay O(1) during a batch
+        self._new_records: List[Tuple[int, int, int, bytes, object]] = []
+        self._queued_by_target: Dict[Tuple[int, int],
+                                     Tuple[bytes, object]] = {}
+
+    # ---- meta -------------------------------------------------------------
+
+    def check_meta(self, slasher) -> None:
+        meta = self.backend.get(_COL_META, b"shape")
+        if meta is not None:
+            _n, history = struct.unpack(">QQ", meta)
+            if history != slasher.history:
+                raise ValueError(
+                    f"persisted history_length {history} != configured "
+                    f"{slasher.history} (the reference likewise refuses to "
+                    "reuse a DB with a different history_length)"
+                )
 
     # ---- write side -------------------------------------------------------
 
-    def mark_validator_dirty(self, v: int) -> None:
-        self._dirty_chunks.add(v // _CHUNK_VALIDATORS)
-
-    def record(self, v: int, source: int, target: int, att) -> None:
-        self._new_records.append((v, source, target, att))
+    def record(self, v: int, source: int, target: int, data_root: bytes,
+               att) -> None:
+        self._new_records.append((v, source, target, data_root, att))
+        self._queued_by_target[(v, target)] = (data_root, att)
 
     def flush(self, slasher) -> int:
-        """Write dirty tiles + pending records; returns tiles written."""
         wrote = 0
-        for chunk in sorted(self._dirty_chunks):
-            lo = chunk * _CHUNK_VALIDATORS
-            hi = min(lo + _CHUNK_VALIDATORS, slasher._n)
-            if lo >= hi:
-                continue
-            key = struct.pack(">Q", chunk)
-            self.backend.put(_COL_MIN, key,
-                             slasher._min_target[lo:hi].tobytes())
-            self.backend.put(_COL_MAX, key,
-                             slasher._max_target[lo:hi].tobytes())
+        for v, s, t, root, att in self._new_records:
+            value = bytes(root) + self._serialize(att)
+            self.backend.put(_COL_REC, _rec_key(v, s, t), value)
             wrote += 1
-        self._dirty_chunks.clear()
-        for v, s, t, att in self._new_records:
-            self.backend.put(
-                _COL_REC, _rec_key(v, s, t),
-                self.types.IndexedAttestation.serialize(att),
-            )
         self._new_records.clear()
+        self._queued_by_target.clear()
         self.backend.put(_COL_META, b"shape", struct.pack(
             ">QQ", slasher._n, slasher.history
         ))
         return wrote
 
+    def _serialize(self, att) -> bytes:
+        if self.types is None:
+            import pickle
+
+            return pickle.dumps(att)
+        return self.types.IndexedAttestation.serialize(att)
+
+    def _deserialize(self, raw: bytes):
+        if self.types is None:
+            import pickle
+
+            return pickle.loads(raw)
+        return self.types.IndexedAttestation.deserialize(raw)
+
     # ---- read side --------------------------------------------------------
 
-    def restore(self, slasher) -> bool:
-        """Load persisted state into a fresh Slasher; False if none."""
-        meta = self.backend.get(_COL_META, b"shape")
-        if meta is None:
-            return False
-        n, history = struct.unpack(">QQ", meta)
-        if history != slasher.history:
-            raise ValueError(
-                f"persisted history_length {history} != configured "
-                f"{slasher.history} (the reference likewise refuses to "
-                "reuse a DB with a different history_length)"
-            )
-        slasher._grow(n)
-        for key, raw in self.backend.iter_column(_COL_MIN):
-            chunk = struct.unpack(">Q", key)[0]
-            lo = chunk * _CHUNK_VALIDATORS
-            tile = np.frombuffer(raw, dtype=np.uint64).reshape(-1, history)
-            slasher._min_target[lo:lo + tile.shape[0]] = tile
-        for key, raw in self.backend.iter_column(_COL_MAX):
-            chunk = struct.unpack(">Q", key)[0]
-            lo = chunk * _CHUNK_VALIDATORS
-            tile = np.frombuffer(raw, dtype=np.uint64).reshape(-1, history)
-            slasher._max_target[lo:lo + tile.shape[0]] = tile
-        for key, raw in self.backend.iter_column(_COL_REC):
-            v, s, t = _unrec_key(key)
-            att = self.types.IndexedAttestation.deserialize(raw)
-            root = self.types.AttestationData.hash_tree_root(att.data)
-            slasher._by_target[(v, t)] = (root, att)
-            slasher._records[(v, s, t)] = att
-        return True
+    def get_record(self, v: int, target: int):
+        """(data_root, attestation) of v's recorded attestation with the
+        given target, or None. Queued records first, then a backend seek."""
+        hit = self._queued_by_target.get((v, target))
+        if hit is not None:
+            return hit
+        start = struct.pack(">QQQ", target, v, 0)
+        for key, raw in self.backend.iter_column_from(_COL_REC, start):
+            kt, kv, _ks = struct.unpack(">QQQ", key)
+            if kt != target or kv != v:
+                break
+            return raw[:32], self._deserialize(raw[32:])
+        return None
+
+    # ---- pruning ----------------------------------------------------------
 
     def prune(self, low_epoch: int) -> int:
         """Drop records below the history window. Keys sort target-first, so
@@ -186,6 +221,10 @@ class SlasherPersistence:
         Records still queued for flush below the window are dropped too —
         they would otherwise be re-persisted by the next flush()."""
         self._new_records = [r for r in self._new_records if r[2] >= low_epoch]
+        self._queued_by_target = {
+            k: val for k, val in self._queued_by_target.items()
+            if k[1] >= low_epoch
+        }
         drop = []
         for key, _ in self.backend.iter_column(_COL_REC):
             if _unrec_key(key)[2] >= low_epoch:

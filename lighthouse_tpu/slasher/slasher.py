@@ -1,27 +1,50 @@
 """Slasher — surround/double-vote detection over 2D min/max-target arrays.
 
-Mirror of slasher/src: attestations index into per-validator epoch arrays
-(array.rs:22-30 layout — validators x epochs, chunked); `MinTargetChunk` /
-`MaxTargetChunk` (:106,:112) hold, for each (validator, source_epoch), the
-min/max attestation target seen with source > / < that epoch. A new
-attestation surrounds an old one iff min_target[v][source+1..] dips below
-its target (and is surrounded iff max_target exceeds it). Double votes are
-caught by a per-(validator, target) record of the attestation root.
+Mirror of slasher/src/array.rs: the state is two sparse 2D matrices over
+(validator, epoch) storing 16-bit TARGET DISTANCES (array.rs:22-30 layout,
+MAX_DISTANCE=u16::MAX):
 
-TPU-first twist: the arrays are dense numpy matrices updated with
-vectorized prefix scans over the epoch axis — the 2D-chunk scheme of the
-reference without the LMDB paging (the store column persists chunks;
-jax.vmap is a drop-in for the update sweep at mainnet validator counts,
-SURVEY.md §7.2 step 8).
+    min_target[v, e] = min target among v's attestations with source > e
+    max_target[v, e] = max target among v's attestations with source < e
+
+A new attestation (s, t) SURROUNDS a recorded one iff t > min_target[v,s]
+(MinTargetChunk::check_slashable) and is SURROUNDED iff t < max_target[v,s]
+(MaxTargetChunk::check_slashable); double votes are caught by the
+per-(validator, target) attestation record. Matrices are tiled into
+chunk_size x validator_chunk_size chunks (defaults 16 x 256,
+config.rs:9-10), zlib-compressed on disk, and paged through a bounded
+write-back cache — memory stays proportional to the working set, not to
+validators x history (the round-1 gap: dense uint64 matrices in RAM).
+
+TPU-first twist: the reference updates cells in per-epoch scalar walks
+with early exit (array.rs MinTargetChunk::update); here an attestation's
+whole epoch range is applied as ONE vectorized numpy minimum/maximum per
+chunk row segment — the elementwise-extremum formulation is exactly
+equivalent (a candidate with smaller/larger target never wins) and maps
+directly onto jax for on-device batches (SURVEY.md §7.2 step 8).
+
+Epoch columns are addressed by ABSOLUTE epoch (chunk column = epoch //
+chunk_size); pruning drops whole chunk columns below the history window
+instead of re-using them ring-buffer style.
 """
 
 from __future__ import annotations
 
 import threading
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
+
+MAX_DISTANCE = 2**16 - 1
+
+
+@dataclass
+class SlasherConfig:
+    chunk_size: int = 16                 # epochs per chunk (config.rs:9)
+    validator_chunk_size: int = 256      # validators per chunk (config.rs:10)
+    history_length: int = 4096           # epochs of coverage (config.rs:11)
+    chunk_cache_len: int = 4096          # paged chunks kept in memory
 
 
 @dataclass
@@ -32,29 +55,237 @@ class AttesterSlashingStatus:
     prior: Optional[object] = None  # the conflicting indexed attestation
 
 
+class TargetArray:
+    """One disk-resident distance matrix (min or max) with a write-back
+    chunk cache. NOT thread-safe: the owning Slasher serializes access."""
+
+    def __init__(self, backend, column: str, cfg: SlasherConfig, kind: str):
+        self.backend = backend
+        self.column = column
+        self.cfg = cfg
+        self.kind = kind
+        self.neutral = np.uint16(MAX_DISTANCE if kind == "min" else 0)
+        self._cache: Dict[Tuple[int, int], np.ndarray] = {}
+        self._dirty: Set[Tuple[int, int]] = set()
+
+    # -- chunk paging --------------------------------------------------------
+
+    def _key(self, vci: int, ci: int) -> bytes:
+        import struct
+
+        return struct.pack(">QQ", vci, ci)
+
+    def _chunk(self, vci: int, ci: int) -> np.ndarray:
+        k = (vci, ci)
+        arr = self._cache.get(k)
+        if arr is None:
+            raw = self.backend.get(self.column, self._key(vci, ci))
+            if raw is None:
+                arr = np.full(
+                    (self.cfg.validator_chunk_size, self.cfg.chunk_size),
+                    self.neutral, dtype=np.uint16,
+                )
+            else:
+                import zlib
+
+                arr = np.frombuffer(
+                    zlib.decompress(raw), dtype=np.uint16
+                ).reshape(
+                    self.cfg.validator_chunk_size, self.cfg.chunk_size
+                ).copy()
+            if len(self._cache) >= self.cfg.chunk_cache_len:
+                self._evict_one()
+            self._cache[k] = arr
+        return arr
+
+    def _evict_one(self) -> None:
+        for k in list(self._cache):
+            if k not in self._dirty:
+                del self._cache[k]
+                return
+        # All dirty: flush everything, then drop one.
+        self.flush()
+        k = next(iter(self._cache))
+        del self._cache[k]
+
+    def flush(self) -> int:
+        import zlib
+
+        wrote = 0
+        for k in sorted(self._dirty):
+            self.backend.put(
+                self.column, self._key(*k),
+                zlib.compress(self._cache[k].tobytes()),
+            )
+            wrote += 1
+        self._dirty.clear()
+        return wrote
+
+    # -- cell ops ------------------------------------------------------------
+
+    def get_targets_many(self, vs, epoch: int):
+        """Recorded extremum target per validator for queries at source ==
+        epoch: dict v -> target, omitting neutral cells. One vectorized
+        read per touched validator chunk."""
+        cfg = self.cfg
+        ci, off = divmod(epoch, cfg.chunk_size)
+        out = {}
+        by_vci: Dict[int, list] = {}
+        for v in vs:
+            by_vci.setdefault(v // cfg.validator_chunk_size, []).append(v)
+        for vci, group in by_vci.items():
+            arr = self._chunk(vci, ci)
+            voffs = np.asarray(
+                [v % cfg.validator_chunk_size for v in group], dtype=np.int64
+            )
+            dists = arr[voffs, off]
+            for v, d in zip(group, dists):
+                if int(d) != int(self.neutral):
+                    out[v] = epoch + int(d)
+        return out
+
+    def get_target(self, v: int, epoch: int) -> Optional[int]:
+        """Recorded extremum target for queries at source == epoch, or None
+        if neutral (no relevant attestation)."""
+        cfg = self.cfg
+        arr = self._chunk(v // cfg.validator_chunk_size,
+                          epoch // cfg.chunk_size)
+        d = int(arr[v % cfg.validator_chunk_size, epoch % cfg.chunk_size])
+        if d == int(self.neutral):
+            return None
+        return epoch + d
+
+    def update_range(self, v: int, lo: int, hi: int, target: int) -> None:
+        """Apply `target` as a min/max candidate to columns [lo, hi]
+        (inclusive), vectorized per chunk segment, walking OUTWARD from the
+        attestation's source side with chunk-level early termination.
+
+        Candidate at column e is the distance target - e; comparisons are
+        on signed ints so an out-of-range (negative-distance) candidate
+        never wins. Early stop is sound by the reference's monotonicity
+        argument (array.rs Min/MaxTargetChunk::update "we can stop"): the
+        recorded extremum visible at a column always beats or ties the
+        extremum one column further out, so once the far-end cell of a
+        segment fails to improve, no later cell can."""
+        if hi < lo:
+            return
+        cfg = self.cfg
+        C = cfg.chunk_size
+        vci, voff = divmod(v, cfg.validator_chunk_size)
+        descending = self.kind == "min"   # min walks DOWN from source-1
+        ci_range = range(hi // C, lo // C - 1, -1) if descending else \
+            range(lo // C, hi // C + 1)
+        for ci in ci_range:
+            seg_lo = max(lo, ci * C) - ci * C
+            seg_hi = min(hi, ci * C + C - 1) - ci * C
+            arr = self._chunk(vci, ci)
+            row = arr[voff, seg_lo:seg_hi + 1].astype(np.int64)
+            epochs = np.arange(ci * C + seg_lo, ci * C + seg_hi + 1,
+                               dtype=np.int64)
+            cand = target - epochs
+            if descending:
+                # neutral (65535) means "none": any in-window candidate wins
+                cand = np.where(cand < 0, MAX_DISTANCE, cand)
+                new = np.minimum(row, cand)
+            else:
+                cand = np.where(cand < 0, 0, cand)
+                new = np.maximum(row, cand)
+            changed = new != row
+            if changed.any():
+                arr[voff, seg_lo:seg_hi + 1] = new.astype(np.uint16)
+                self._dirty.add((vci, ci))
+            far = 0 if descending else -1
+            if not changed[far]:
+                return
+
+    def update_range_many(self, vs, lo: int, hi: int, target: int) -> None:
+        """update_range for MANY validators of one attestation at once:
+        all rows of a validator chunk update in a single 2D minimum/maximum
+        (the batch-axis vectorization the scalar walk of array.rs cannot
+        do). Early termination is per chunk COLUMN: stop when no row
+        improved its far-end cell."""
+        if hi < lo or not vs:
+            return
+        cfg = self.cfg
+        C = cfg.chunk_size
+        descending = self.kind == "min"
+        by_vci: Dict[int, list] = {}
+        for v in vs:
+            by_vci.setdefault(v // cfg.validator_chunk_size, []).append(v)
+        ci_range = range(hi // C, lo // C - 1, -1) if descending else \
+            range(lo // C, hi // C + 1)
+        for vci, group in by_vci.items():
+            voffs = np.asarray(
+                [v % cfg.validator_chunk_size for v in group], dtype=np.int64
+            )
+            for ci in ci_range:
+                seg_lo = max(lo, ci * C) - ci * C
+                seg_hi = min(hi, ci * C + C - 1) - ci * C
+                arr = self._chunk(vci, ci)
+                block = arr[np.ix_(voffs, range(seg_lo, seg_hi + 1))] \
+                    .astype(np.int64)
+                epochs = np.arange(ci * C + seg_lo, ci * C + seg_hi + 1,
+                                   dtype=np.int64)
+                cand = target - epochs
+                if descending:
+                    cand = np.where(cand < 0, MAX_DISTANCE, cand)
+                    new = np.minimum(block, cand)
+                else:
+                    cand = np.where(cand < 0, 0, cand)
+                    new = np.maximum(block, cand)
+                changed = new != block
+                if changed.any():
+                    arr[np.ix_(voffs, range(seg_lo, seg_hi + 1))] = \
+                        new.astype(np.uint16)
+                    self._dirty.add((vci, ci))
+                far = 0 if descending else -1
+                if not changed[:, far].any():
+                    break
+
+    def prune_below(self, low_epoch: int) -> int:
+        """Delete whole chunk COLUMNS below the window."""
+        low_ci = low_epoch // self.cfg.chunk_size
+        import struct
+
+        drop = []
+        for key, _ in self.backend.iter_column(self.column):
+            vci, ci = struct.unpack(">QQ", key)
+            if ci < low_ci:
+                drop.append(key)
+        for key in drop:
+            self.backend.delete(self.column, key)
+        for k in [k for k in self._cache if k[1] < low_ci]:
+            self._cache.pop(k)
+            self._dirty.discard(k)
+        return len(drop)
+
+
 class Slasher:
-    HISTORY_EPOCHS = 4096  # default history_length (slasher config)
+    HISTORY_EPOCHS = SlasherConfig.history_length
 
     def __init__(self, n_validators: int = 0, history_epochs: int = None,
-                 persistence=None):
-        self.history = history_epochs or self.HISTORY_EPOCHS
-        self.persistence = persistence  # SlasherPersistence | None
+                 persistence=None, config: SlasherConfig = None):
+        from .database import (
+            _COL_MAX,
+            _COL_MIN,
+            MemorySlasherBackend,
+            SlasherPersistence,
+        )
+
+        self.cfg = config or SlasherConfig()
+        if history_epochs:
+            self.cfg.history_length = history_epochs
+        self.history = self.cfg.history_length
         self._lock = threading.Lock()
-        # min_target[v, s] = min target over recorded attestations of v with
-        # source > s;  max_target[v, s] = max target with source < s.
-        # Sentinel: +inf / 0.
-        self._n = 0
-        self._min_target = np.zeros((0, self.history), dtype=np.uint64)
-        self._max_target = np.zeros((0, self.history), dtype=np.uint64)
-        self._INF = np.iinfo(np.uint64).max
-        # (validator, target_epoch) -> (data_root, indexed_attestation)
-        self._by_target: Dict[Tuple[int, int], Tuple[bytes, object]] = {}
-        # (validator, source, target) -> indexed attestation (for reporting)
-        self._records: Dict[Tuple[int, int, int], object] = {}
-        if n_validators:
-            self._grow(n_validators)
-        if persistence is not None:
-            persistence.restore(self)
+        self._n = n_validators          # informational; arrays are sparse
+        self._current = 0               # watermark: max target seen
+        if persistence is None:
+            persistence = SlasherPersistence(MemorySlasherBackend(), None)
+        self.persistence = persistence
+        persistence.check_meta(self)
+        backend = persistence.backend
+        self.min_targets = TargetArray(backend, _COL_MIN, self.cfg, "min")
+        self.max_targets = TargetArray(backend, _COL_MAX, self.cfg, "max")
 
     @classmethod
     def open(cls, path: str, types, n_validators: int = 0,
@@ -67,119 +298,85 @@ class Slasher:
                    persistence=persistence)
 
     def flush(self) -> int:
-        """Persist dirty chunks + new records (batch-commit point of the
-        reference's per-epoch update loop)."""
-        if self.persistence is None:
-            return 0
+        """Persist dirty chunks + queued records (the batch-commit point of
+        the reference's per-epoch update loop)."""
         with self._lock:
-            return self.persistence.flush(self)
-
-    def _grow(self, n: int) -> None:
-        if n <= self._n:
-            return
-        add = n - self._n
-        self._min_target = np.vstack([
-            self._min_target,
-            np.full((add, self.history), self._INF, dtype=np.uint64),
-        ])
-        self._max_target = np.vstack([
-            self._max_target,
-            np.zeros((add, self.history), dtype=np.uint64),
-        ])
-        self._n = n
-
-    def _e(self, epoch: int) -> int:
-        return epoch % self.history
+            wrote = self.min_targets.flush() + self.max_targets.flush()
+            self.persistence.flush(self)
+            return wrote
 
     # ------------------------------------------------------------- checking
 
     def process_attestation(
-        self, indexed_attestation, data_root: bytes
+        self, indexed_attestation, data_root: bytes,
+        current_epoch: Optional[int] = None,
     ) -> List[Tuple[int, AttesterSlashingStatus]]:
         """Check + record one attestation for each attester; returns the
-        slashable findings [(validator_index, status)] (the batch update
-        loop processes the queue per epoch; the per-attestation core is
-        identical)."""
+        slashable findings [(validator_index, status)]."""
         data = indexed_attestation.data
         source = int(data.source.epoch)
         target = int(data.target.epoch)
         out: List[Tuple[int, AttesterSlashingStatus]] = []
         with self._lock:
-            need = max(indexed_attestation.attesting_indices, default=-1) + 1
-            self._grow(max(need, self._n))
-            for v in indexed_attestation.attesting_indices:
-                status = self._check_one(v, source, target, data_root)
+            self._current = max(self._current, target,
+                                current_epoch or 0)
+            vs = list(indexed_attestation.attesting_indices)
+            self._n = max(self._n, max(vs, default=-1) + 1)
+            # Batched surround checks: one vectorized cell read per touched
+            # validator chunk instead of per-validator lookups.
+            min_hits = self.min_targets.get_targets_many(vs, source)
+            max_hits = self.max_targets.get_targets_many(vs, source)
+            for v in vs:
+                status = self._check_one(v, source, target, data_root,
+                                         min_hits.get(v), max_hits.get(v))
                 if status.kind != "not_slashable":
                     out.append((v, status))
-                self._record(v, source, target, data_root, indexed_attestation)
+                self.persistence.record(v, source, target, data_root,
+                                        indexed_attestation)
+            low = max(0, self._current - self.history + 1)
+            if source > 0:
+                self.min_targets.update_range_many(vs, low, source - 1,
+                                                   target)
+            # The max side clamps to the history window too: columns below
+            # it are never queried, and the clamp bounds every stored
+            # distance by history_length (< 2^16) — an ancient-source
+            # attestation would otherwise wrap uint16 distances AND dirty
+            # thousands of chunk columns.
+            self.max_targets.update_range_many(vs, max(source + 1, low),
+                                               self._current, target)
         return out
 
     def _check_one(self, v: int, source: int, target: int,
-                   data_root: bytes) -> AttesterSlashingStatus:
-        prior = self._by_target.get((v, target))
+                   data_root: bytes, mt: Optional[int],
+                   xt: Optional[int]) -> AttesterSlashingStatus:
+        prior = self.persistence.get_record(v, target)
         if prior is not None and prior[0] != data_root:
             return AttesterSlashingStatus("double_vote", prior[1])
-        # Does the new attestation surround a prior one?  Any recorded
-        # (s', t') with s' > source and t' < target  <=>  min over
-        # min_target[v, source] (min target with source' > source) < target.
-        mt = int(self._min_target[v, self._e(source)])
-        if mt != self._INF and mt < target and mt > source:
-            rec = self._find_record_with(v, lambda s, t: s > source and t < target)
-            return AttesterSlashingStatus("surrounds", rec)
-        # Is the new attestation surrounded? Any (s', t') with s' < source
-        # and t' > target  <=>  max_target[v, source] > target.
-        xt = int(self._max_target[v, self._e(source)])
-        if xt > target:
-            rec = self._find_record_with(v, lambda s, t: s < source and t > target)
-            return AttesterSlashingStatus("surrounded", rec)
+        # Surrounds: some recorded (s' > source) has target t' < target
+        # <=> min_target[v, source] < target (MinTargetChunk semantics).
+        if mt is not None and mt < target:
+            rec = self.persistence.get_record(v, mt)
+            return AttesterSlashingStatus(
+                "surrounds", rec[1] if rec else None
+            )
+        # Surrounded: some recorded (s' < source) has target t' > target
+        # <=> max_target[v, source] > target.
+        if xt is not None and xt > target:
+            rec = self.persistence.get_record(v, xt)
+            return AttesterSlashingStatus(
+                "surrounded", rec[1] if rec else None
+            )
         return AttesterSlashingStatus("not_slashable")
-
-    def _find_record_with(self, v: int, pred) -> Optional[object]:
-        for (rv, s, t), att in self._records.items():
-            if rv == v and pred(s, t):
-                return att
-        return None
-
-    def _record(self, v: int, source: int, target: int, data_root: bytes,
-                indexed_attestation) -> None:
-        self._by_target[(v, target)] = (data_root, indexed_attestation)
-        self._records[(v, source, target)] = indexed_attestation
-        if self.persistence is not None:
-            self.persistence.mark_validator_dirty(v)
-            self.persistence.record(v, source, target, indexed_attestation)
-        # Vectorized chunk update (the min/max sweep of MinTargetChunk /
-        # MaxTargetChunk::update): epochs BELOW source get min_target
-        # candidates; epochs ABOVE source get max_target candidates.
-        if source > 0:
-            lo = max(0, source - self.history)
-            idx = np.arange(lo, source) % self.history
-            np.minimum.at(self._min_target[v], idx, np.uint64(target))
-        hi_lo = source + 1
-        hi = min(source + self.history, source + self.history)
-        idx = np.arange(hi_lo, min(hi_lo + self.history - 1,
-                                   source + self.history)) % self.history
-        # max_target[s] over sources < s: this attestation contributes its
-        # target to every s > source.
-        np.maximum.at(self._max_target[v], idx, np.uint64(target))
 
     # ------------------------------------------------------------- pruning
 
     def prune(self, current_epoch: int) -> None:
-        """Drop records older than the history window."""
+        """Drop records + chunk columns older than the history window."""
         low = current_epoch - self.history
         with self._lock:
-            self._by_target = {
-                k: val for k, val in self._by_target.items() if k[1] >= low
-            }
-            self._records = {
-                k: val for k, val in self._records.items() if k[2] >= low
-            }
-            # The backend prune must not interleave with flush()'s puts
-            # (flush holds this lock). The scan cost is proportional to
-            # what's pruned (target-first key order), so holding the lock
-            # is a bounded stall.
-            if self.persistence is not None:
-                self.persistence.prune(low)
+            self.persistence.prune(low)
+            self.min_targets.prune_below(low)
+            self.max_targets.prune_below(low)
 
 
 class SlasherService:

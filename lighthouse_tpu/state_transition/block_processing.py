@@ -472,10 +472,12 @@ def apply_deposit(state, types, spec, pubkey, withdrawal_credentials, amount, si
             )
         )
         state.balances.append(amount)
-        # altair+ accounting lists grow with the registry
-        state.previous_epoch_participation.append(0)
-        state.current_epoch_participation.append(0)
-        state.inactivity_scores.append(0)
+        # altair+ accounting lists grow with the registry (a phase0 state
+        # has PendingAttestation lists instead — nothing to grow).
+        if hasattr(state, "previous_epoch_participation"):
+            state.previous_epoch_participation.append(0)
+            state.current_epoch_participation.append(0)
+            state.inactivity_scores.append(0)
     else:
         index = pubkeys.index(bytes(pubkey))
         h.increase_balance(state, index, amount)

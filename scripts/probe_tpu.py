@@ -1,0 +1,91 @@
+"""Per-stage TPU profiling + batch-scaling probe for the verify pipeline.
+
+Usage: python scripts/probe_tpu.py [n_sets ...]
+Times hash_to_g2 / prepare / pairing separately at each batch size and
+reports sigs/sec (informs NOTES_TPU_PERF.md and the batch-former policy).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    sizes = [int(a) for a in sys.argv[1:]] or [256]
+    import jax
+
+    from lighthouse_tpu.crypto.bls.api import SecretKey, Signature, SignatureSet
+    from lighthouse_tpu.ops import backend as be
+    from lighthouse_tpu.ops import h2c
+    import __graft_entry__ as ge
+
+    print(f"devices: {jax.devices()}", file=sys.stderr)
+    for n in sizes:
+        k = 4
+        sets = ge._example_sets(min(n, 64), keys_per_set=k)
+        # replicate staged tensors up to n (staging cost, not verify cost)
+        u, pk, sig, chk, mask, sc = ge._stage(sets, len(sets), k)
+        reps = n // len(sets)
+        u = np.tile(np.asarray(u), (reps, 1, 1, 1))[:n]
+        pk = np.tile(np.asarray(pk), (reps, 1, 1, 1))[:n]
+        sig = np.tile(np.asarray(sig), (reps, 1, 1, 1))[:n]
+        chk = np.tile(np.asarray(chk), reps)[:n]
+        mask = np.tile(np.asarray(mask), reps)[:n]
+        sc = np.arange(1, n + 1, dtype=np.uint64) * np.uint64(0x9E3779B9)
+
+        import jax.numpy as jnp
+
+        args = tuple(jnp.asarray(x) for x in (u, pk, sig, chk, mask, sc))
+
+        stage1 = jax.jit(h2c.hash_to_g2_device)
+        stage2 = jax.jit(be._prepare_pairs)
+        stage3 = jax.jit(be._pairing_check)
+
+        try:
+            t0 = time.monotonic()
+            h = stage1(args[0])
+            h.block_until_ready()
+            c1 = time.monotonic() - t0
+
+            t0 = time.monotonic()
+            p_aff, s_aff, valid = stage2(*args[1:])
+            jax.block_until_ready((p_aff, s_aff, valid))
+            c2 = time.monotonic() - t0
+
+            t0 = time.monotonic()
+            out = stage3(p_aff, h, s_aff, args[4], valid)
+            out.block_until_ready()
+            c3 = time.monotonic() - t0
+            print(f"n={n} compile+first: h2c {c1:.2f}s prep {c2:.2f}s "
+                  f"pair {c3:.2f}s ok={bool(out)}", file=sys.stderr)
+
+            # steady-state: 3 timed iterations
+            times = {"h2c": [], "prep": [], "pair": []}
+            for _ in range(3):
+                t0 = time.monotonic()
+                h = stage1(args[0]); h.block_until_ready()
+                times["h2c"].append(time.monotonic() - t0)
+                t0 = time.monotonic()
+                p_aff, s_aff, valid = stage2(*args[1:])
+                jax.block_until_ready((p_aff, s_aff, valid))
+                times["prep"].append(time.monotonic() - t0)
+                t0 = time.monotonic()
+                out = stage3(p_aff, h, s_aff, args[4], valid)
+                out.block_until_ready()
+                times["pair"].append(time.monotonic() - t0)
+            h2c_t = min(times["h2c"]); prep_t = min(times["prep"])
+            pair_t = min(times["pair"])
+            total = h2c_t + prep_t + pair_t
+            print(f"n={n} steady: h2c {h2c_t:.3f}s prep {prep_t:.3f}s "
+                  f"pair {pair_t:.3f}s total {total:.3f}s "
+                  f"-> {n / total:.1f} sigs/s")
+        except Exception as e:
+            print(f"n={n} FAILED: {type(e).__name__}: {str(e)[:300]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -91,7 +91,7 @@ def main():
     msgs4 = [bytes([i]) * 32 for i in range(4)]
     u4 = _h2c.hash_to_field_device(msgs4)
     jax.jit(_h2c.hash_to_g2_device)(u4).block_until_ready()
-    jax.jit(_h2c.map_to_curve_sswu)(u4).block_until_ready()
+    jax.jit(_h2c.map_to_curve_sswu_projective)(u4)[0].block_until_ready()
     print(f"h2c-suite shapes warm ({time.time() - t2b:.0f}s)")
 
     # NOTE: the device-KZG graph and the bench shape are deliberately NOT

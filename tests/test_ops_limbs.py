@@ -99,11 +99,16 @@ def test_inv_and_pow():
 
 
 def test_mul_output_digit_bounds():
-    """Post-mul digits sit in [0, 256] (the loose-canonical contract the
+    """Post-mul digits sit in [0, 259) (the loose-canonical contract the
     squeeze/fold bound analysis depends on)."""
     xs = [rng.randrange(P) for _ in range(32)]
     out = np.asarray(lb.mul(to_dev(xs), to_dev(xs)))
-    assert out.min() >= 0.0 and out.max() <= 256.0
+    assert out.min() >= 0.0 and out.max() <= 258.0
+
+
+def test_sqr_matches_mul():
+    xs = [rng.randrange(P) for _ in range(16)]
+    assert from_dev(lb.sqr(to_dev(xs))) == [(x * x) % P for x in xs]
 
 
 def test_staging_roundtrip():

@@ -135,3 +135,25 @@ def test_phase0_genesis_crosses_every_fork_with_finality():
     cls = types.BeaconStateCapella
     data = cls.serialize(state)
     assert cls.serialize(cls.deserialize(data)) == data
+
+
+def test_phase0_deposit_processing():
+    """A phase0 block deposit grows the registry WITHOUT touching the
+    altair participation fields BeaconStateBase does not have."""
+    from lighthouse_tpu.crypto.bls import api as bls
+    from lighthouse_tpu.state_transition import block_processing as bp
+
+    spec = replace(minimal_spec(), altair_fork_epoch=4, bellatrix_fork_epoch=5,
+                   capella_fork_epoch=6, deneb_fork_epoch=None)
+    types = make_types(spec.preset)
+    keys = gen.generate_deterministic_keypairs(16)
+    state = gen.interop_genesis_state(types, spec, keys,
+                                      fork=ForkName.BASE)
+    sk = bls.SecretKey(424242)
+    pk = sk.public_key().to_bytes()
+    n0 = len(state.validators)
+    bp.apply_deposit(state, types, spec, pk, b"\x00" * 32,
+                     spec.max_effective_balance, b"\x00" * 96,
+                     verify_signature=False)
+    assert len(state.validators) == n0 + 1
+    assert len(state.balances) == n0 + 1
