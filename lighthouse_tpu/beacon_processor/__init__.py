@@ -5,6 +5,7 @@ from .processor import (
     DEFAULT_MAX_BATCH,
     PRIORITY,
     QUEUE_CAPS,
+    AdaptiveBatchPolicy,
     BeaconProcessor,
     WorkEvent,
 )
@@ -12,6 +13,7 @@ from .reprocess import ReprocessQueue
 
 __all__ = [
     "BATCHABLE",
+    "AdaptiveBatchPolicy",
     "BeaconProcessor",
     "DEFAULT_MAX_BATCH",
     "PRIORITY",

@@ -69,6 +69,36 @@ DEFAULT_MAX_BATCH = 64  # lib.rs:215-216
 BATCHABLE = {"gossip_attestation", "gossip_aggregate"}
 
 
+class AdaptiveBatchPolicy:
+    """Batch-size policy driven by the device bucket grid (SURVEY §7.1(3),
+    VERDICT round-1 item 7). The reference pins gossip batches at 64
+    because CPU batches amortize poorly against poisoned-batch retries
+    (lib.rs:205-216); the device backend amortizes into the thousands and
+    isolates poison with on-device bisection, so the cap becomes: the
+    largest power-of-two bucket <= the queue depth, bounded by
+    `max_bucket` and by one GROWTH STEP past the largest bucket that has
+    already run (a gossip burst must not trigger a surprise cold compile
+    of a brand-new shape mid-slot; shapes warm progressively and the
+    persistent cache remembers them across restarts)."""
+
+    def __init__(self, max_bucket: int = 4096, warm=(64,)):
+        self.max_bucket = max_bucket
+        self.warm = set(warm)
+
+    def batch_limit(self, depth: int) -> int:
+        if depth < 2:
+            return 1
+        b = 1 << (depth.bit_length() - 1)          # largest pow2 <= depth
+        b = min(b, self.max_bucket)
+        growth_cap = 2 * max(self.warm, default=1)
+        return max(2, min(b, growth_cap))
+
+    def note_ran(self, n: int) -> None:
+        if n >= 2:
+            bucket = 1 << ((n - 1).bit_length())   # shape the backend pads to
+            self.warm.add(min(bucket, self.max_bucket))
+
+
 @dataclass
 class WorkEvent:
     kind: str
@@ -91,8 +121,10 @@ class BeaconProcessor:
         self,
         max_batch: int = DEFAULT_MAX_BATCH,
         max_workers: int = 4,
+        batch_policy: Optional[AdaptiveBatchPolicy] = None,
     ):
         self.max_batch = max_batch
+        self.batch_policy = batch_policy   # None => fixed max_batch (CPU)
         self.queues: Dict[str, Deque[WorkEvent]] = {
             k: deque() for k in QUEUE_CAPS
         }
@@ -126,8 +158,10 @@ class BeaconProcessor:
             if not q:
                 continue
             if kind in BATCHABLE and len(q) >= 2:
+                limit = (self.batch_policy.batch_limit(len(q))
+                         if self.batch_policy is not None else self.max_batch)
                 batch = []
-                while q and len(batch) < self.max_batch:
+                while q and len(batch) < limit:
                     batch.append(q.popleft())
                 return batch
             return [q.popleft()]
@@ -142,6 +176,8 @@ class BeaconProcessor:
         if len(work) > 1:
             self.stats.batches += 1
             self.stats.batched_items += len(work)
+            if self.batch_policy is not None:
+                self.batch_policy.note_ran(len(work))
             batch_fn = work[0].process_batch
             if batch_fn is not None:
                 batch_fn([w.item for w in work])

@@ -15,7 +15,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from lighthouse_tpu.beacon_chain import BeaconChain
-from lighthouse_tpu.beacon_processor import BeaconProcessor
+from lighthouse_tpu.beacon_processor import AdaptiveBatchPolicy, BeaconProcessor
 from lighthouse_tpu.common.slot_clock import ManualSlotClock, SystemTimeSlotClock
 from lighthouse_tpu.execution_layer import ExecutionLayer, MockExecutionEngine
 from lighthouse_tpu.http_api import BeaconApiServer
@@ -289,7 +289,13 @@ class ClientBuilder:
             )
         op_pool.restore(store)
 
-        processor = BeaconProcessor()
+        # Device-backed verification amortizes far past the reference's
+        # 64-item gossip cap: drive the batch former by the compiled
+        # bucket grid (beacon_processor.AdaptiveBatchPolicy).
+        processor = BeaconProcessor(
+            batch_policy=AdaptiveBatchPolicy()
+            if cfg.bls_backend == "tpu" else None
+        )
         network = None
         if transport is not None:
             network = NetworkService(peer_id, transport, chain,

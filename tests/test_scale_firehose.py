@@ -1,0 +1,141 @@
+"""Scale test: attestation-ingest latency at a 100k-validator set, and
+the import/fork-choice lock split (VERDICT round-1 item 9).
+
+The reference's envelope: 16,384-deep unaggregated queues
+(beacon_processor/src/lib.rs:90-106) and slot-third deadlines (attestation
+duty at slot+1/3). Here: a 100k-validator state (synthetic registry tail
+grafted onto a real interop genesis — pubkeys are never decompressed on
+this path with the fake signature backend), vectorized committee
+shuffling, and per-attestation gossip ingest measured against the
+slot-third budget. The lock-split check drives attestation ingest and
+attestation-data production WHILE a thread holds the import lock — the
+firehose path takes only the fork-choice lock and head reads are
+lock-free snapshots, so neither may stall."""
+
+import threading
+import time
+
+import pytest
+
+from lighthouse_tpu.testing.harness import BeaconChainHarness
+from lighthouse_tpu.types.spec import FAR_FUTURE_EPOCH
+
+N_EXTRA = 100_000
+GWEI_32 = 32 * 10**9
+
+
+def _graft_validators(chain, n_extra: int) -> None:
+    types = chain.types
+    state = chain.head.state
+    for i in range(n_extra):
+        state.validators.append(types.Validator(
+            pubkey=(1_000_000 + i).to_bytes(48, "big"),
+            withdrawal_credentials=b"\x00" * 32,
+            effective_balance=GWEI_32,
+            slashed=False,
+            activation_eligibility_epoch=0,
+            activation_epoch=0,
+            exit_epoch=FAR_FUTURE_EPOCH,
+            withdrawable_epoch=FAR_FUTURE_EPOCH,
+        ))
+        state.balances.append(GWEI_32)
+        state.previous_epoch_participation.append(0)
+        state.current_epoch_participation.append(0)
+        state.inactivity_scores.append(0)
+
+
+@pytest.mark.slow
+def test_firehose_ingest_latency_100k():
+    harness = BeaconChainHarness(n_validators=32, bls_backend="fake")
+    chain, spec, types = harness.chain, harness.chain.spec, harness.chain.types
+    _graft_validators(chain, N_EXTRA)
+    # Synthetic registry tail has no decompressible pubkeys; signature
+    # checks run on the fake backend, so any pubkey object satisfies the
+    # signature-set construction.
+    pk0 = chain.pubkey_cache.get(0)
+    chain.pubkey_getter = lambda i: pk0
+    sig = harness.keys[0].sign(b"m" * 32).to_bytes()  # decodable G2
+    slot = 1
+    chain.slot_clock.set_slot(slot)
+
+    # Epoch shuffling over 100k validators: one-time per epoch, must be
+    # seconds not minutes (the vectorized swap-or-not path).
+    t0 = time.monotonic()
+    committees = chain.committees_at(slot)
+    shuffle_secs = time.monotonic() - t0
+    assert shuffle_secs < 15.0, f"epoch shuffling took {shuffle_secs:.1f}s"
+
+    per_slot = committees.committees_per_slot
+    assert per_slot >= 1
+    # Single-bit gossip attestations across the slot's committees.
+    atts = []
+    for index in range(per_slot):
+        committee = committees.committee(slot, index)
+        data = chain.produce_unaggregated_attestation(slot, index)
+        for pos in range(0, min(len(committee), 256)):
+            bits = [False] * len(committee)
+            bits[pos] = True
+            atts.append(types.Attestation(
+                aggregation_bits=bits, data=data, signature=sig
+            ))
+    assert len(atts) >= 256
+
+    lat = []
+    for att in atts:
+        t0 = time.monotonic()
+        chain.process_attestation(att)
+        lat.append(time.monotonic() - t0)
+    lat.sort()
+    p50 = lat[len(lat) // 2]
+    p99 = lat[int(len(lat) * 0.99)]
+    third = spec.seconds_per_slot / 3.0
+    # Every single-attestation ingest must fit far inside a slot third
+    # (the wire + signature costs live elsewhere; this is the host
+    # committee/fork-choice/pool path the lock split protects).
+    assert p99 < third / 4, f"p99 ingest {p99*1e3:.1f}ms vs third {third}s"
+    print(f"\n100k-validator ingest: n={len(lat)} p50={p50*1e3:.2f}ms "
+          f"p99={p99*1e3:.2f}ms (slot third {third:.1f}s, "
+          f"shuffle {shuffle_secs:.1f}s)")
+
+
+@pytest.mark.slow
+def test_attestation_paths_do_not_wait_on_import_lock():
+    """Hold the IMPORT lock for 2 s in another thread; attestation ingest
+    (fork-choice lock only) and attestation production (lock-free head
+    snapshot) must complete orders of magnitude faster."""
+    harness = BeaconChainHarness(n_validators=64, bls_backend="fake")
+    chain, types = harness.chain, harness.chain.types
+    slot = 1
+    chain.slot_clock.set_slot(slot)
+    committees = chain.committees_at(slot)
+    committee = committees.committee(slot, 0)
+    data = chain.produce_unaggregated_attestation(slot, 0)
+    bits = [False] * len(committee)
+    bits[0] = True
+    att = types.Attestation(aggregation_bits=bits, data=data,
+                            signature=harness.keys[0].sign(
+                                b"m" * 32).to_bytes())
+
+    hold = threading.Event()
+    release = threading.Event()
+
+    def import_holder():
+        with chain._lock:
+            hold.set()
+            release.wait(4.0)
+
+    t = threading.Thread(target=import_holder)
+    t.start()
+    assert hold.wait(2.0)
+    try:
+        t0 = time.monotonic()
+        chain.process_attestation(att)
+        ingest = time.monotonic() - t0
+        t0 = time.monotonic()
+        chain.produce_unaggregated_attestation(slot, 0)
+        produce = time.monotonic() - t0
+    finally:
+        release.set()
+        t.join()
+    assert ingest < 1.0, f"ingest waited on the import lock: {ingest:.2f}s"
+    assert produce < 1.0, f"production waited on the import lock: {produce:.2f}s"
