@@ -163,7 +163,9 @@ def into_execution_pending_block(
         state, chain.types, chain.spec, signed_block, fork,
         verify_signatures=bp.VerifySignatures.FALSE,
     )
-    root = chain.types.BeaconState[fork].hash_tree_root(state)
+    from lighthouse_tpu.types.tree_cache import state_root_cached
+
+    root = state_root_cached(chain.types.BeaconState[fork], state)
     if bytes(block.state_root) != root:
         raise BlockError("StateRootMismatch")
 
@@ -223,7 +225,9 @@ def verify_chain_segment(chain, blocks: List[object]) -> List[SignatureVerifiedB
             scratch, chain.types, chain.spec, signed_block, fork,
             verify_signatures=bp.VerifySignatures.FALSE,
         )
-        root = chain.types.BeaconState[fork].hash_tree_root(scratch)
+        from lighthouse_tpu.types.tree_cache import state_root_cached
+
+        root = state_root_cached(chain.types.BeaconState[fork], scratch)
         if bytes(block.state_root) != root:
             raise BlockError("StateRootMismatch", f"slot {block.slot}")
 

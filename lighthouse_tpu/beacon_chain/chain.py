@@ -167,6 +167,9 @@ class BeaconChain:
         self.observed_block_producers = ObservedBlockProducers()
         self.observed_sync_contributors = ObservedAttesters()
         self.early_attester_cache = EarlyAttesterCache()
+        # proposer_index -> fee recipient (VC prepare_beacon_proposer
+        # registrations, preparation_service.rs).
+        self.proposer_preparations = {}
         self.attester_cache = AttesterCache()
         self.block_times_cache = BlockTimesCache()
 
@@ -617,6 +620,7 @@ class BeaconChain:
                     self.op_pool.get_slashings_and_exits(state)
                 bls_changes = self.op_pool.get_bls_to_execution_changes(state)
 
+            proposer = h.get_beacon_proposer_index(state, spec)
             payload_header = None
             if blinded:
                 payload_header = prefetched_bid.message.header
@@ -635,6 +639,7 @@ class BeaconChain:
                     timestamp=state.genesis_time + slot * spec.seconds_per_slot,
                     prev_randao=h.get_randao_mix(state, spec, epoch),
                     withdrawals=bp.get_expected_withdrawals(state, t, spec),
+                    fee_recipient=self.proposer_preparations.get(proposer),
                 )
             else:
                 import hashlib as _hl
@@ -653,7 +658,6 @@ class BeaconChain:
                     withdrawals=bp.get_expected_withdrawals(state, t, spec),
                 )
 
-            proposer = h.get_beacon_proposer_index(state, spec)
             # Sync aggregate: messages were signed at slot-1 over this
             # block's parent root (per_block_processing expects exactly that).
             sync_aggregate = self.sync_contribution_pool.best_sync_aggregate(

@@ -140,6 +140,25 @@ class BeaconNodeHttpClient:
     def get_head_header(self) -> Dict[str, Any]:
         return self._get("/eth/v1/beacon/headers/head")["data"]
 
+    def post_beacon_committee_subscriptions(self, subs) -> None:
+        """subs: [{validator_index, committee_index, committees_at_slot,
+        slot, is_aggregator}] (duties_service.rs subnet pushes)."""
+        self._post(
+            "/eth/v1/validator/beacon_committee_subscriptions", subs
+        )
+
+    def post_sync_committee_subscriptions(self, subs) -> None:
+        self._post(
+            "/eth/v1/validator/sync_committee_subscriptions", subs
+        )
+
+    def post_prepare_beacon_proposer(self, preparations) -> None:
+        """preparations: [{validator_index, fee_recipient}] hex addr
+        (preparation_service.rs)."""
+        self._post(
+            "/eth/v1/validator/prepare_beacon_proposer", preparations
+        )
+
     def post_sync_duties(self, epoch: int,
                          indices: List[int]) -> List[Dict[str, Any]]:
         return self._post(

@@ -105,12 +105,15 @@ class ExecutionLayer:
     # ----------------------------------------------------------- get payload
 
     def get_payload(self, parent_hash: bytes, timestamp: int,
-                    prev_randao: bytes, withdrawals: Optional[List] = None):
-        """Two-phase build: fcU(attributes) -> payloadId -> getPayload."""
+                    prev_randao: bytes, withdrawals: Optional[List] = None,
+                    fee_recipient: Optional[bytes] = None):
+        """Two-phase build: fcU(attributes) -> payloadId -> getPayload.
+        `fee_recipient` overrides the default (the VC preparation service's
+        per-proposer registration, prepare_beacon_proposer)."""
         attrs = {
             "timestamp": timestamp,
             "prevRandao": prev_randao,
-            "suggestedFeeRecipient": self.fee_recipient,
+            "suggestedFeeRecipient": fee_recipient or self.fee_recipient,
             "withdrawals": withdrawals or [],
         }
         out = self.notify_forkchoice_updated(

@@ -60,6 +60,8 @@ class BeaconApiServer:
     def __init__(self, chain, network=None, port: int = 0):
         self.chain = chain
         self.network = network
+        self.subnet_subscriptions = set()
+        self.sync_subnet_subscriptions = set()
         self.events = EventBus()
         outer = self
 
@@ -367,9 +369,57 @@ class BeaconApiServer:
 
         if path == "/eth/v1/validator/beacon_committee_subscriptions" and \
                 method == "POST":
+            # Join the attestation subnets the VC's duties land on
+            # (subnet_service; duties_service.rs pushes these per epoch).
+            from lighthouse_tpu.network.types import (
+                attestation_subnet_topic,
+                compute_subnet_for_attestation,
+            )
+
+            for sub in body or []:
+                subnet = compute_subnet_for_attestation(
+                    self.chain.spec, int(sub["slot"]),
+                    int(sub["committee_index"]),
+                    int(sub["committees_at_slot"]),
+                )
+                self.subnet_subscriptions.add(subnet)
+                if self.network is not None:
+                    # Same 4-subnet fold + validation closure the network
+                    # layer publishes with (service.py publish_attestation)
+                    # — an unfolded or unvalidated topic would either never
+                    # see traffic or mesh-forward unverified messages.
+                    self.network.gossip.subscribe(
+                        attestation_subnet_topic(
+                            subnet % 4, self.network.fork_digest
+                        ),
+                        validator=self.network._validate_attestation,
+                    )
             return {}
         if path == "/eth/v1/validator/sync_committee_subscriptions" and \
                 method == "POST":
+            from lighthouse_tpu.beacon_chain.sync_committee import (
+                SYNC_COMMITTEE_SUBNET_COUNT,
+            )
+
+            sub_size = max(
+                1,
+                self.chain.spec.preset.SYNC_COMMITTEE_SIZE
+                // SYNC_COMMITTEE_SUBNET_COUNT,
+            )
+            for sub in body or []:
+                self.sync_subnet_subscriptions.update(
+                    int(x) // sub_size
+                    for x in sub.get("sync_committee_indices", [])
+                )
+            return {}
+        if path == "/eth/v1/validator/prepare_beacon_proposer" and \
+                method == "POST":
+            # preparation_service.rs: per-proposer fee recipients feed the
+            # payload-attributes of that proposer's getPayload.
+            for prep in body or []:
+                self.chain.proposer_preparations[
+                    int(prep["validator_index"])
+                ] = bytes.fromhex(prep["fee_recipient"][2:])
             return {}
 
         if path == "/eth/v1/beacon/pool/sync_committees" and method == "POST":

@@ -18,8 +18,10 @@ class SlotProcessingError(Exception):
 
 
 def process_slot(state, types, spec, state_cls) -> None:
+    from lighthouse_tpu.types.tree_cache import state_root_cached
+
     P = spec.preset
-    state_root = state_cls.hash_tree_root(state)
+    state_root = state_root_cached(state_cls, state)
     state.state_roots[state.slot % P.SLOTS_PER_HISTORICAL_ROOT] = state_root
     if bytes(state.latest_block_header.state_root) == b"\x00" * 32:
         state.latest_block_header.state_root = state_root

@@ -396,6 +396,32 @@ class Container(metaclass=_ContainerMeta):
         if kwargs:
             raise TypeError(f"unknown fields: {sorted(kwargs)}")
 
+    def __setattr__(self, name, value):
+        # Dirty-tracking hook for the incremental tree-hash cache
+        # (types/tree_cache.py): any SSZ-field assignment marks the
+        # container so only touched elements re-hash.
+        object.__setattr__(self, name, value)
+        if not name.startswith("_"):
+            self.__dict__["_tree_dirty"] = True
+
+    def __deepcopy__(self, memo):
+        import copy as _copy
+
+        cls = type(self)
+        new = cls.__new__(cls)
+        memo[id(self)] = new
+        for k, v in self.__dict__.items():
+            if k == "_tree_cache":
+                # Clone the incremental tree cache by memcpy of its layer
+                # arrays (tree_cache.deep_clone) — cheap next to a full
+                # re-hash, and keeps per-import state clones warm.
+                new.__dict__[k] = v.deep_clone()
+            elif k == "_tree_dirty":
+                new.__dict__[k] = v
+            else:
+                new.__dict__[k] = _copy.deepcopy(v, memo)
+        return new
+
     def __eq__(self, other):
         if type(self) is not type(other):
             return NotImplemented

@@ -49,6 +49,8 @@ class ValidatorClient:
         spec,
         doppelganger_epochs: int = 0,
         builder_proposals: bool = False,
+        fee_recipient: bytes = b"\x00" * 20,
+        slot_clock=None,
     ):
         self.store = store
         self.bn = beacon_nodes
@@ -63,6 +65,12 @@ class ValidatorClient:
         self.proposer_duties: Dict[int, List[dict]] = {}
         self.sync_duties: Dict[int, List[dict]] = {}
         self._fork_info: Optional[dict] = None
+        # preparation_service.rs: the fee recipient registered per proposer.
+        self.fee_recipient = fee_recipient
+        # Real-clock deployments pace duties to slot thirds
+        # (attestation_service.rs spawns at slot+1/3, aggregates at +2/3);
+        # lockstep tests leave this None and run duties immediately.
+        self.slot_clock = slot_clock
         # produced attestations awaiting aggregation: slot -> list of dicts
         self._own_attestations: Dict[int, List[dict]] = {}
 
@@ -105,6 +113,45 @@ class ValidatorClient:
         self.proposer_duties[epoch] = self.bn.call(
             lambda c: c.get_proposer_duties(epoch)
         )
+        self._push_subscriptions(epoch)
+        self._push_preparations(indices)
+
+    def _push_subscriptions(self, epoch: int) -> None:
+        """Tell the BN which attestation subnets this epoch's duties land
+        on (duties_service.rs subnet pushes -> subnet_service)."""
+        subs = [
+            {
+                "validator_index": int(d["validator_index"]),
+                "committee_index": int(d["committee_index"]),
+                "committees_at_slot": int(d.get("committees_at_slot", 1)),
+                "slot": int(d["slot"]),
+                "is_aggregator": True,
+            }
+            for d in self.attester_duties.get(epoch, [])
+        ]
+        if subs:
+            try:
+                self.bn.call(
+                    lambda c: c.post_beacon_committee_subscriptions(subs)
+                )
+            except Exception:
+                pass  # subscriptions are an optimization, not a duty
+
+    def _push_preparations(self, indices) -> None:
+        """Register fee recipients for every managed validator
+        (preparation_service.rs; consumed by the BN's payload attributes)."""
+        preps = [
+            {"validator_index": int(i),
+             "fee_recipient": "0x" + self.fee_recipient.hex()}
+            for i in indices
+        ]
+        if preps:
+            try:
+                self.bn.call(
+                    lambda c: c.post_prepare_beacon_proposer(preps)
+                )
+            except Exception:
+                pass
 
     # ------------------------------------------------------------- per slot
 
@@ -112,19 +159,50 @@ class ValidatorClient:
         """Execute this slot's duties: propose, attest, aggregate.
         Returns counters for observability."""
         epoch = self.spec.epoch_at_slot(slot)
+        P = self.spec.preset
         if epoch not in self.attester_duties:
             self.poll_duties(epoch)
+        # Mid-epoch PREFETCH of next epoch's duties (duties_service.rs
+        # polls ahead so the epoch boundary needs no synchronous fetch).
+        if slot % P.SLOTS_PER_EPOCH == P.SLOTS_PER_EPOCH // 2 and \
+                epoch + 1 not in self.attester_duties:
+            try:
+                self.poll_duties(epoch + 1)
+            except Exception:
+                pass
         stats = {"blocks": 0, "attestations": 0, "aggregates": 0,
                  "sync_messages": 0, "sync_contributions": 0}
         if not self.doppelganger_safe(epoch):
             return stats
         stats["blocks"] = self._block_duty(slot)
+        self._wait_until_third(slot, 1)
         stats["attestations"] = self._attestation_duty(slot)
+        stats["sync_messages"] = self._sync_message_duty(slot)
+        self._wait_until_third(slot, 2)
         stats["aggregates"] = self._aggregate_duty(slot)
-        sm, sc = self._sync_committee_duty(slot)
-        stats["sync_messages"] = sm
-        stats["sync_contributions"] = sc
+        # Contributions aggregate the pool at 2/3 — after the other
+        # members' 1/3 messages have landed (sync_committee_service.rs).
+        stats["sync_contributions"] = self._sync_contribution_duty(slot)
+        # Drop stale duty epochs (bounded memory across long runs).
+        for book in (self.attester_duties, self.proposer_duties,
+                     self.sync_duties):
+            for e in [e for e in book if e < epoch - 1]:
+                del book[e]
         return stats
+
+    def _wait_until_third(self, slot: int, third: int) -> None:
+        """Real-clock pacing: sleep until slot + third/3 (attestations fire
+        at 1/3, aggregates at 2/3 — attestation_service.rs discipline).
+        No-op in lockstep mode (no slot clock attached)."""
+        if self.slot_clock is None:
+            return
+        import time as _time
+
+        target = self.slot_clock.start_of(slot) + \
+            third * self.spec.seconds_per_slot / 3.0
+        delay = target - self.slot_clock._now_seconds()
+        if 0 < delay < self.spec.seconds_per_slot:
+            _time.sleep(delay)
 
     # ---------------------------------------------------------------- block
 
@@ -227,10 +305,9 @@ class ValidatorClient:
 
     # --------------------------------------------------------- sync committee
 
-    def _sync_committee_duty(self, slot: int):
-        """SyncCommitteeService: members sign the head root each slot; the
-        selected aggregators publish contributions
-        (sync_committee_service.rs)."""
+    def _sync_duties_for(self, slot: int):
+        """Resolve (duties, fork_info, head_root, own-key map) for the
+        slot's epoch, or None on transient BN errors."""
         epoch = self.spec.epoch_at_slot(slot)
         if epoch not in self.sync_duties:
             indices = [
@@ -244,15 +321,23 @@ class ValidatorClient:
                     lambda c: c.post_sync_duties(epoch, indices)
                 )
             except Exception:
-                return 0, 0  # transient BN error: retry next slot, don't cache
+                return None  # transient BN error: retry next slot
         duties = self.sync_duties[epoch]
         if not duties:
-            return 0, 0
+            return None
         fork_info = self._ensure_fork_info()
         header = self.bn.call(lambda c: c.get_head_header())
         head_root = bytes.fromhex(header["root"][2:])
         own = {pk.hex(): pk for pk in self.store.voting_pubkeys()}
+        return duties, fork_info, head_root, own
 
+    def _sync_message_duty(self, slot: int) -> int:
+        """SyncCommitteeService message phase (slot + 1/3): members sign
+        the head root (sync_committee_service.rs)."""
+        ctx = self._sync_duties_for(slot)
+        if ctx is None:
+            return 0
+        duties, fork_info, head_root, own = ctx
         msgs = []
         for duty in duties:
             pk = own.get(duty["pubkey"][2:])
@@ -271,8 +356,19 @@ class ValidatorClient:
             ))
         if msgs:
             self.bn.call(lambda c: c.submit_sync_messages(msgs))
+        self._sync_head_root = head_root
+        return len(msgs)
 
-        # Aggregation phase (slot + 2/3): selected per subcommittee.
+    def _sync_contribution_duty(self, slot: int) -> int:
+        """Contribution phase (slot + 2/3): selected aggregators fetch the
+        pool aggregate AFTER other members' messages have landed."""
+        ctx = self._sync_duties_for(slot)
+        if ctx is None:
+            return 0
+        duties, fork_info, _, own = ctx
+        head_root = getattr(self, "_sync_head_root", None)
+        if head_root is None:
+            return 0
         from lighthouse_tpu.beacon_chain.sync_committee import (
             SYNC_COMMITTEE_SUBNET_COUNT,
             is_sync_committee_aggregator,
@@ -326,8 +422,8 @@ class ValidatorClient:
                     lambda c: c.submit_contribution_and_proofs(contribs)
                 )
             except Eth2ClientError:
-                return len(msgs), 0
-        return len(msgs), len(contribs)
+                return 0
+        return len(contribs)
 
     # ------------------------------------------------------------- aggregate
 

@@ -205,3 +205,17 @@ def test_fcu_invalid_zero_lvh_means_no_valid_ancestor():
         proto.index_by_root[bad_root]
     ].execution_status is ExecutionStatus.INVALID
     assert chain.head.block_root == good_head
+
+
+def test_produce_block_with_execution_layer_and_preparation():
+    """produce_block on an EL-backed chain builds a payload through the
+    engine and honors the proposer's registered fee recipient (the
+    prepare_beacon_proposer plumbing)."""
+    harness, engine, el = _harness_with_el()
+    chain = harness.chain
+    chain.slot_clock.set_slot(1)
+    for i in range(len(chain.head.state.validators)):
+        chain.proposer_preparations[i] = b"\xbb" * 20
+    block, _state = chain.produce_block(1, randao_reveal=b"\x00" * 96)
+    assert block.body.execution_payload.block_number >= 1
+    assert bytes(block.body.execution_payload.fee_recipient) == b"\xbb" * 20

@@ -1,0 +1,108 @@
+"""Incremental BeaconState tree hashing (types/tree_cache.py) — bit-exact
+vs the plain merkleization, warm across copies, sublinear in validators
+touched (VERDICT round-1 Missing #4 / item 8)."""
+
+import time
+
+import pytest
+
+from lighthouse_tpu.state_transition import genesis as gen
+from lighthouse_tpu.types.containers import make_types
+from lighthouse_tpu.types.spec import FAR_FUTURE_EPOCH, minimal_spec
+from lighthouse_tpu.types.tree_cache import state_root_cached
+
+
+def _setup():
+    spec = minimal_spec()
+    types = make_types(spec.preset)
+    keys = gen.generate_deterministic_keypairs(16)
+    return spec, types, gen.interop_genesis_state(types, spec, keys)
+
+
+def test_matches_plain_root_and_tracks_mutations():
+    spec, types, state = _setup()
+    cls = types.BeaconStateCapella
+    assert state_root_cached(cls, state) == cls.hash_tree_root(state)
+    # Mutations through every cached field class.
+    state.validators[3].effective_balance -= 5
+    state.validators[9].slashed = True
+    state.balances[7] += 123
+    state.inactivity_scores[2] = 9
+    state.current_epoch_participation[11] = 7
+    state.randao_mixes[2] = b"\x99" * 32
+    state.slot += 1
+    assert state_root_cached(cls, state) == cls.hash_tree_root(state)
+    # Registry growth (deposit path).
+    state.validators.append(types.Validator(
+        pubkey=b"\x05" * 48, withdrawal_credentials=b"\x00" * 32,
+        effective_balance=32 * 10**9, slashed=False,
+        activation_eligibility_epoch=0, activation_epoch=0,
+        exit_epoch=FAR_FUTURE_EPOCH, withdrawable_epoch=FAR_FUTURE_EPOCH,
+    ))
+    state.balances.append(32 * 10**9)
+    state.current_epoch_participation.append(0)
+    state.previous_epoch_participation.append(0)
+    state.inactivity_scores.append(0)
+    assert state_root_cached(cls, state) == cls.hash_tree_root(state)
+
+
+def test_copies_stay_warm_and_independent():
+    spec, types, state = _setup()
+    cls = types.BeaconStateCapella
+    r0 = state_root_cached(cls, state)
+    clone = state.copy()
+    clone.balances[0] += 1
+    assert state_root_cached(cls, clone) == cls.hash_tree_root(clone)
+    # The original's cached root is unaffected by the clone's update.
+    assert state_root_cached(cls, state) == r0
+
+
+def test_slot_processing_uses_cache_consistently():
+    """Drive real per-slot processing across an epoch boundary — the
+    cached roots recorded into state_roots must equal plain hashing."""
+    from lighthouse_tpu.state_transition import slot_processing as sp
+
+    spec, types, state = _setup()
+    cls = types.BeaconStateCapella
+    check = state.copy()
+    state = sp.process_slots(state, types, spec,
+                             spec.preset.SLOTS_PER_EPOCH + 2)
+    check.__dict__.pop("_tree_cache", None)
+    check = sp.process_slots(check, types, spec,
+                             spec.preset.SLOTS_PER_EPOCH + 2)
+    assert cls.hash_tree_root(state) == cls.hash_tree_root(check)
+    assert list(map(bytes, state.state_roots)) == \
+        list(map(bytes, check.state_roots))
+
+
+@pytest.mark.slow
+def test_sublinear_at_scale():
+    """Touch 100 of 50k validators: the incremental root must beat the
+    full recompute by an order of magnitude."""
+    spec, types, state = _setup()
+    cls = types.BeaconStateCapella
+    G = 32 * 10**9
+    for i in range(50_000):
+        state.validators.append(types.Validator(
+            pubkey=(10 + i).to_bytes(48, "big"),
+            withdrawal_credentials=b"\x00" * 32,
+            effective_balance=G, slashed=False,
+            activation_eligibility_epoch=0, activation_epoch=0,
+            exit_epoch=FAR_FUTURE_EPOCH, withdrawable_epoch=FAR_FUTURE_EPOCH,
+        ))
+        state.balances.append(G)
+        state.current_epoch_participation.append(0)
+        state.previous_epoch_participation.append(0)
+        state.inactivity_scores.append(0)
+    state_root_cached(cls, state)                     # warm
+    for i in range(0, 1000, 10):
+        state.validators[i].effective_balance -= 1
+        state.balances[i] += 7
+    t0 = time.monotonic()
+    got = state_root_cached(cls, state)
+    warm = time.monotonic() - t0
+    t0 = time.monotonic()
+    want = cls.hash_tree_root(state)
+    full = time.monotonic() - t0
+    assert got == want
+    assert warm * 10 < full, f"incremental {warm:.3f}s vs full {full:.3f}s"
