@@ -5,37 +5,41 @@ mainnet-attestation-style batch of signature sets through the device backend
 (`lighthouse_tpu.ops.backend.verify_signature_sets_tpu`), and prints ONE JSON
 line:
 
-    {"metric": ..., "value": N, "unit": "sigs/sec", "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": "sigs/sec", "vs_baseline": N, ...}
 
-`vs_baseline` is measured throughput divided by BLST_CPU_BASELINE — an
-order-of-magnitude estimate of the reference's rayon-parallel blst batch
-verify on a 16-core host (~0.7 ms/set/core; the reference publishes no
-absolute numbers, BASELINE.md). Refine when the C++ comparator lands.
+`vs_baseline` divides by a MEASURED same-host baseline: the native C++
+batch verifier (native/src/blscpu.cpp — Montgomery arithmetic, batch-
+inverted Miller loop, same batch equation and h2c), single-threaded on
+this box, measured in the same process right before the device run
+(VERDICT round 2, missing #2: the round-2 divisor was an unmeasured
+estimate). The old order-of-magnitude blst estimate is still reported as
+`vs_blst_16core_estimate` for continuity with BENCH_r01/r02
+(~0.7 ms/set/core on a 16-core host; the reference publishes no absolute
+numbers, BASELINE.md).
 
 Uses whatever accelerator JAX finds (real TPU under axon; CPU otherwise).
 """
 
 import json
+import os
 import time
 
-BLST_CPU_BASELINE_SIGS_PER_SEC = 20_000.0
+BLST_16CORE_ESTIMATE_SIGS_PER_SEC = 20_000.0
 
 # Batch shape: 1024 sets x 4 aggregated pubkeys. The reference caps GOSSIP
 # batches at 64 (beacon_processor/src/lib.rs:215-216) because CPU batches
 # amortize poorly against poisoning risk; the BASELINE.json eval configs
 # measure 1k/10k/100k-set batches (chain-segment replay + op-pool shapes)
 # and device throughput rises with batch (NOTES_TPU_PERF.md scaling
-# table — the round-1 executable-size ceiling that pinned the bench at
-# 256 is gone). Override with LIGHTHOUSE_TPU_BENCH_SETS.
-import os
-
+# table). Override with LIGHTHOUSE_TPU_BENCH_SETS.
 N_SETS = int(os.environ.get("LIGHTHOUSE_TPU_BENCH_SETS", "1024"))
 KEYS_PER_SET = 4
 N_DISTINCT = 64       # distinct sets signed on the host; tiled up to N_SETS
 TIMED_ITERS = 3
+CPU_BASELINE_SETS = 32  # sets per CPU-baseline iteration (~0.2 s each)
 
 
-def _make_sets():
+def _make_sets(n: int):
     from lighthouse_tpu.crypto.bls.api import (
         AggregateSignature,
         SecretKey,
@@ -55,17 +59,47 @@ def _make_sets():
                 message=msg,
             )
         )
-    # Tile up to N_SETS: device work is identical per set; host signing
-    # time is staging cost, not the measured metric.
-    return (sets * ((N_SETS + N_DISTINCT - 1) // N_DISTINCT))[:N_SETS]
+    # Tile: device work is identical per set; host signing time is staging
+    # cost, not the measured metric.
+    return (sets * ((n + N_DISTINCT - 1) // N_DISTINCT))[:n]
 
 
-def _emit(sigs_per_sec: float, error: str = "") -> None:
+def measure_cpu_baseline(sets) -> float:
+    """Single-threaded native C++ verifier throughput on this host
+    (sigs/sec), same semantics and subgroup-check amortization flags as
+    the device run. Returns 0.0 when the native toolchain is missing."""
+    try:
+        from lighthouse_tpu.crypto.bls import cpu_backend
+
+        batch = sets[:CPU_BASELINE_SETS]
+        if not cpu_backend.verify_signature_sets_cpu(batch):  # warm + check
+            return 0.0
+        iters = 0
+        t0 = time.perf_counter()
+        while iters < 2 or time.perf_counter() - t0 < 2.0:
+            if not cpu_backend.verify_signature_sets_cpu(batch):
+                return 0.0
+            iters += 1
+            if iters >= 50:
+                break
+        dt = time.perf_counter() - t0
+        return len(batch) * iters / dt
+    except Exception:
+        return 0.0
+
+
+def _emit(sigs_per_sec: float, cpu_baseline: float, error: str = "") -> None:
+    baseline = cpu_baseline if cpu_baseline > 0 else \
+        BLST_16CORE_ESTIMATE_SIGS_PER_SEC
     out = {
         "metric": "bls_batch_verify_throughput",
         "value": round(sigs_per_sec, 2),
         "unit": "sigs/sec",
-        "vs_baseline": round(sigs_per_sec / BLST_CPU_BASELINE_SIGS_PER_SEC, 4),
+        "vs_baseline": round(sigs_per_sec / baseline, 4),
+        "cpu_baseline_sigs_per_sec": round(cpu_baseline, 2),
+        "vs_blst_16core_estimate": round(
+            sigs_per_sec / BLST_16CORE_ESTIMATE_SIGS_PER_SEC, 4
+        ),
     }
     if error:
         out["error"] = error
@@ -73,19 +107,27 @@ def _emit(sigs_per_sec: float, error: str = "") -> None:
 
 
 def main():
+    cpu_baseline = 0.0
     try:
         import jax
 
         from lighthouse_tpu.ops import backend as be
 
-        sets = _make_sets()
+        sets = _make_sets(N_SETS)
+        # Measure the host baseline FIRST (the device warm-up below may
+        # compile for minutes; the baseline is quick and independent).
+        cpu_baseline = measure_cpu_baseline(sets)
+
         n_dev = len(jax.devices())
         sharded = n_dev > 1 and N_SETS % n_dev == 0
+
+        # The bench measures the DEVICE path: disable small-batch routing.
+        os.environ["LIGHTHOUSE_TPU_CPU_FALLBACK_MAX"] = "0"
 
         # Warm-up: compile (persistent-cached) + one correctness check.
         ok = be.verify_signature_sets_tpu(sets, sharded=sharded)
         if not ok:
-            _emit(0.0, "benchmark batch failed verification")
+            _emit(0.0, cpu_baseline, "benchmark batch failed verification")
             return 1
 
         # Time at least TIMED_ITERS iterations and at least ~2 seconds.
@@ -93,16 +135,16 @@ def main():
         t0 = time.perf_counter()
         while iters < TIMED_ITERS or time.perf_counter() - t0 < 2.0:
             if not be.verify_signature_sets_tpu(sets, sharded=sharded):
-                _emit(0.0, "verification flaked mid-benchmark")
+                _emit(0.0, cpu_baseline, "verification flaked mid-benchmark")
                 return 1
             iters += 1
             if iters >= 50:
                 break
         dt = time.perf_counter() - t0
-        _emit(N_SETS * iters / dt)
+        _emit(N_SETS * iters / dt, cpu_baseline)
         return 0
     except Exception as e:  # the driver needs its JSON line no matter what
-        _emit(0.0, repr(e))
+        _emit(0.0, cpu_baseline, repr(e))
         return 1
 
 

@@ -208,10 +208,12 @@ class BeaconChain:
         """Fork/domain/pubkey context for signature sets — read-only use."""
         return self.head.state
 
-    def head_state_clone_at(self, slot: int):
+    def head_state_clone_at(self, slot: int, head=None):
         """Clone of the head state advanced to (at least) `slot`'s epoch
-        start — shuffling/proposer decisions."""
-        state = self.head.state
+        start — shuffling/proposer decisions. Callers that read several
+        head fields pass their own snapshot so a concurrent head swap
+        cannot mix two heads' data."""
+        state = (head or self.head).state
         target_epoch = self.spec.epoch_at_slot(slot)
         if h.get_current_epoch(state, self.spec) >= target_epoch:
             return state
@@ -499,13 +501,18 @@ class BeaconChain:
             return early
         t, spec = self.types, self.spec
         epoch = spec.epoch_at_slot(slot)
-        head_state = self.head.state
+        # ONE lock-free head snapshot for the whole assembly: a concurrent
+        # recompute_head swap must not mix head A's justified/epoch data
+        # with head B's block root (the immutable-snapshot discipline of
+        # canonical_head.rs).
+        head = self.head
+        head_state = head.state
         if epoch > spec.epoch_at_slot(head_state.slot):
             # Cross-epoch request (skipped slots over the boundary): the
             # attester cache supplies the justified checkpoint + committee
             # count without replaying the head state (attester_cache.rs).
             hit = self.attester_cache.get(
-                epoch, self.head.block_root
+                epoch, head.block_root
             )
             if hit is not None:
                 justified, lengths = hit
@@ -515,27 +522,27 @@ class BeaconChain:
                     return t.AttestationData(
                         slot=slot,
                         index=committee_index,
-                        beacon_block_root=self.head.block_root,
+                        beacon_block_root=head.block_root,
                         source=justified,
                         target=t.Checkpoint(epoch=epoch,
-                                            root=self.head.block_root),
+                                            root=head.block_root),
                     )
-        state = self.head_state_clone_at(slot)
+        state = self.head_state_clone_at(slot, head=head)
         if epoch > spec.epoch_at_slot(head_state.slot):
             # Fill the cache from the advanced clone so the NEXT request
             # in this epoch skips the replay.
             self.attester_cache.cache_advanced(
-                self.head.block_root, state, spec, epoch
+                head.block_root, state, spec, epoch
             )
         if slot < state.slot:
             head_root = h.get_block_root_at_slot(state, spec, slot)
         else:
-            head_root = self.head.block_root
+            head_root = head.block_root
         target_start = spec.start_slot_of_epoch(epoch)
         if target_start < state.slot:
             target_root = h.get_block_root_at_slot(state, spec, target_start)
         else:
-            target_root = self.head.block_root
+            target_root = head.block_root
         return t.AttestationData(
             slot=slot,
             index=committee_index,
@@ -570,13 +577,16 @@ class BeaconChain:
             if self.execution_layer is None or \
                     self.execution_layer.builder is None:
                 raise RuntimeError("blinded production requires a builder")
-            ps = self.head_state_clone_at(slot)
+            # One head snapshot for the whole prefetch: proposer shuffling
+            # and parent hash must come from the SAME head (the discipline
+            # of produce_unaggregated_attestation above).
+            head = self.head
+            ps = self.head_state_clone_at(slot, head=head)
             proposer_i = h.get_beacon_proposer_index(ps, self.spec, slot=slot)
             pk = self.pubkey_cache.get(proposer_i)
             prefetched_bid = self.execution_layer.builder.get_header(
                 slot,
-                bytes(self.head.state.latest_execution_payload_header
-                      .block_hash),
+                bytes(head.state.latest_execution_payload_header.block_hash),
                 pk.to_bytes() if pk is not None else b"\x00" * 48,
             )
 

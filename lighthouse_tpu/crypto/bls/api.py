@@ -308,6 +308,9 @@ def set_backend(name: str) -> None:
     if name == "tpu" and "tpu" not in _BACKENDS:
         # Lazy import so the pure-Python oracle has no JAX dependency.
         from lighthouse_tpu.ops import backend as _tpu_backend  # noqa: F401
+    if name == "cpu" and "cpu" not in _BACKENDS:
+        # Lazy: compiles the native verifier on first use.
+        from . import cpu_backend as _cpu_backend  # noqa: F401
     if name not in _BACKENDS:
         raise BlsError(f"unknown BLS backend: {name}")
     _active_backend = name
@@ -324,6 +327,8 @@ def verify_signature_sets(sets: Sequence[SignatureSet], backend: Optional[str] =
     name = backend or _active_backend
     if name == "tpu" and "tpu" not in _BACKENDS:
         from lighthouse_tpu.ops import backend as _tpu_backend  # noqa: F401
+    if name == "cpu" and "cpu" not in _BACKENDS:
+        from . import cpu_backend as _cpu_backend  # noqa: F401
     return _BACKENDS[name](list(sets))
 
 

@@ -1,14 +1,15 @@
 """Device-side (JAX/XLA/Pallas) kernels for BLS12-381 batch verification.
 
 This package is the TPU-native replacement for the reference's blst assembly
-(crypto/bls/src/impls/blst.rs): limb-decomposed 381-bit Montgomery arithmetic,
-field towers, curve ops, the multi-Miller loop and final exponentiation — all
-batched over a leading axis and shardable across a device mesh
-(lighthouse_tpu.parallel).
+(crypto/bls/src/impls/blst.rs): Fp as 48 x 8-bit digits in float32 lanes with
+lazy signed adds (limbs.py — the round-3 engine runs the digit-polynomial
+product as constant-matrix NTT/CRT matmuls on the MXU), field towers, curve
+ops, the multi-Miller loop and final exponentiation — all batched over a
+leading axis and shardable across a device mesh (lighthouse_tpu.parallel).
 
-64-bit integer support is required (limb products are accumulated in uint64);
-we enable jax x64 at import, before any array is created.
-"""
+jax x64 is enabled at import (before any array is created) for the HOST
+staging paths (int <-> digit conversion, oracle cross-checks); the device
+kernels themselves are pure f32/bf16."""
 
 import os
 

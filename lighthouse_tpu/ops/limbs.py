@@ -4,7 +4,8 @@ Replaces the reference's blst assembly field layer (crypto/bls/src/impls/
 blst.rs links Supranational blst; SURVEY.md §2.7 item 1). Differentially
 tested against the pure-Python oracle (lighthouse_tpu.crypto.bls.fields).
 
-Design (round-2 rewrite — the "MXU limb engine"):
+Design (round-3: the "NTT/CRT MXU engine", layered on the round-2 f32
+digit representation):
 
   * An Fp element is L=48 limbs of nominally B=8 bits, held in float32
     lanes, PLAIN representation (no Montgomery form), little-endian:
@@ -20,28 +21,59 @@ Design (round-2 rewrite — the "MXU limb engine"):
     integer of magnitude < 2^24 (f32's exact-integer range); carry passes
     use floor(x/256), exact for any f32.
   * Carry propagation is a constant number of PARALLEL passes over the
-    limb axis — never a loop-carried scan. (The round-1 engine ran a
-    lax.scan over 30 columns per multiply: the limb axis was sequential,
-    so ~1/50 of the VPU lanes did work and the Miller loop became a pure
-    latency chain. See NOTES_TPU_PERF.md.)
-  * Modular reduction is a fold through CONSTANT matrices: the columns
-    above position 48 are contracted against T[k] = digits(2^(8k) mod p)
-    with an MXU matmul (bfloat16 x bfloat16 -> float32, exact for
-    integer operands of magnitude <= 256). Montgomery's data-dependent
-    m = t*N' step — whose carry chain was the round-1 bottleneck — is
-    gone entirely.
-  * Outputs of mul are "loose-canonical": 48 digits in [-1, 256], value
-    in [0, ~1.1 * 2^384) ~ [0, 9p). Comparisons (eq / is_zero / sgn0)
+    limb axis — never a loop-carried scan; _squeeze's final pass carries
+    a +17 digit bias (value-compensated in the K*p offset) so squeezed
+    digits are PROVABLY in [0, 256] even for signed lazy inputs.
+  * THE MULTIPLY IS MATMULS (round-3): the digit-polynomial product —
+    round 2's elementwise 51x101 Toeplitz "column product", the VPU
+    bottleneck — is computed by evaluation/interpolation through
+    CONSTANT matrices on the MXU:
+      - forward: evaluate both squeezed operands (51 digits in [0,256])
+        at the 101 points x=0..100 modulo each small prime in
+        {239, 241, 251} — a single (batch, 51) @ (51, 303) bf16 x bf16
+        -> f32 matmul (entries centered, |.| <= 127: exact);
+      - residues are centered mod p_j with one round-multiply
+        (r = e - p*round(e/p), exact for |e| < 2^22);
+      - pointwise product of residues (|.| <= 127^2, exact), re-center;
+      - inverse: interpolate coefficients with a (3, 101, 101) batched
+        bf16 matmul whose matrices fold in both the Lagrange inverse
+        and the CRT weight (M/p_j)^-1 mod p_j;
+      - CRT: the three centered residues of each product column are
+        recombined to the EXACT column integer in [0, M),
+        M = 239*241*251 = 14,457,349 > 51*256^2 (the max column sum,
+        non-negative by the squeeze bias) using a base-256 split so
+        every f32 intermediate stays < 2^19 (exact); the quotient
+        t = floor(S/M) is estimated by one multiply and pinned by two
+        exact limb-compare corrections.
+  * Modular reduction of the product columns is a fold through CONSTANT
+    matrices: columns above position 48 are contracted against
+    T[k] = digits(2^(8k) mod p) with an MXU matmul. Montgomery's
+    data-dependent m = t*N' step is gone entirely.
+  * Outputs of mul are "loose-canonical": 48 digits in [0, 259), value
+    in [0, 2^384) ~ [0, 8.6p). Comparisons (eq / is_zero / sgn0)
     go through canonicalize(), which produces the unique base-2^8 digits
     of the value reduced to [0, p) using carry-lookahead borrow
     propagation (log-depth associative_scan) — exact, branch-free, and
     only paid on the rare comparison paths.
+  * The NTT domain is exposed (ntt_fwd / ntt_center / ntt_inv_cols) so
+    the tower CAN combine Karatsuba/schoolbook SUMS of products on
+    residues before ever leaving the domain — an Fp12 multiply then
+    costs 24 forward + 12 inverse transforms instead of 108 + 54 field
+    ops. Domain combination must use the 4-prime plan (plan4():
+    headroom for column sums of up to ~64 stacked products plus
+    non-negativity offsets); plain mul/sqr ride the cheaper 3-prime
+    plan.
+
+Set LIGHTHOUSE_TPU_MUL_ENGINE=schoolbook to fall back to the round-2
+elementwise column product (A/B probing).
 
 Naming note: `mont_mul` / `mont_sqr` / `ints_to_mont` / `mont_to_ints` /
 `ONE_MONT` keep their round-1 names as the stable interface of the tower
 and staging layers, but the representation is now plain — `to_mont` is the
 identity and `from_mont` is canonicalize().
 """
+
+import os
 
 import numpy as np
 
@@ -89,7 +121,7 @@ ONE_MONT = jnp.zeros((L,), dtype=DTYPE).at[0].set(1.0)   # plain 1 (name kept)
 # above position L. Entries are 8-bit digits (<= 255), exact in bfloat16;
 # contracting high columns against T_FOLD reduces the value mod p while
 # shrinking its magnitude by ~16x per round (sum_j c_j t_j <= 0.12 * value).
-_MAX_FOLD_ROWS = NCOLS + 4 - L   # enough for the widest padded product
+_MAX_FOLD_ROWS = NCOLS + 12 - L  # widest padded product incl. CRT limb shifts
 _T_FOLD_NP = np.stack([
     int_to_limbs(pow(2, B * (L + j), P)) for j in range(_MAX_FOLD_ROWS)
 ])
@@ -165,26 +197,39 @@ def _fold_dot(hi, nrows: int):
     )
 
 
-# Non-negativity offset: a ~2^393 multiple of p, staged as base-2^8
-# digits over W_IN columns. Added before digit-squeezing so that every
-# value entering the carry machinery is POSITIVE — _carry_pass drops the
-# top column's outgoing carry, which is only sound when the (padded)
-# width strictly bounds a non-negative value.
-_OFFSET_K = (1 << 393) // P + 1
-_OFFSET_SQ = jnp.asarray(int_to_limbs(_OFFSET_K * P, width=W_IN), dtype=DTYPE)
+# Non-negativity offset: K*p minus the digit-bias compensation (see
+# _squeeze), staged as base-2^8 digits over W_IN columns. Added before
+# digit-squeezing so that every value entering the carry machinery is
+# POSITIVE — _carry_pass drops the top column's outgoing carry, which is
+# only sound when the (padded) width strictly bounds a non-negative value.
+_SQ_BIAS = 17.0
+_E_WIN = sum(1 << (B * i) for i in range(W_IN))      # all-ones digit value
+_OFFSET_K = (int(_SQ_BIAS) * _E_WIN + (1 << 392)) // P + 1
+_OFFSET_SQ = jnp.asarray(
+    int_to_limbs(_OFFSET_K * P - int(_SQ_BIAS) * _E_WIN, width=W_IN),
+    dtype=DTYPE,
+)
 
 
 def _squeeze(x):
-    """Digit-squeeze an operand for the column product: shift non-negative
-    (+Kp, a no-op mod p), then 3 parallel passes bring digits into
-    [0, 256] WITHOUT folding the value (width grows to W_IN).
+    """Digit-squeeze an operand for the product: shift non-negative
+    (+Kp - 17*E, a no-op mod p once the bias is restored), then 3
+    parallel passes bring digits PROVABLY into [0, 256] WITHOUT folding
+    the value (width grows to W_IN).
 
-    Input contract: |digit| <= 2^20 and |value| < 2^392 (< the 2^393
-    offset). After the shift, digits <= 2^20 + 255: pass 1 leaves
-    <= 255 + 2^12, pass 2 <= 255 + 17, pass 3 <= 256; the carry wave
-    reaches column 50 with magnitude <= 56 — W_IN = 51 keeps the top
-    column carry-free (value < 2^394 << 2^408)."""
-    return _passes(_pad_cols(x, W_IN) + _OFFSET_SQ, 3)
+    Input contract: |digit| <= 2^20 and |value| < 2^392 (< the offset
+    value, so the shifted value is non-negative and < 2^405 << 2^408).
+    Digit bounds: after the shift, |digit| <= 2^20 + 255; pass 1 leaves
+    digits in [-2^12, 255 + 2^12 + 1]; pass 2 in [-16, 272]; adding the
+    +17 bias (whose value 17*E was pre-subtracted from the offset) gives
+    [1, 289], so pass 3's carries are in [0, 1] and the result digits sit
+    in [0, 256] — non-negative even for signed lazy inputs (round 2's
+    analysis allowed a -1; the CRT reconstruction in the NTT engine
+    additionally REQUIRES non-negative column sums, see _ntt_inv_cols).
+    The carry wave reaches column 50 with magnitude well under the
+    headroom of the top offset digit (< 256 total)."""
+    y = _passes(_pad_cols(x, W_IN) + _OFFSET_SQ, 2)
+    return _carry_pass(y + _SQ_BIAS)
 
 
 def _fold_small(x, nrows: int):
@@ -225,14 +270,283 @@ def _reduce(x, folds: int = 5):
     return _passes(_pad_cols(x, L + 3), 2)[..., :L]
 
 
+# --- NTT/CRT multiply plan (round 3) --------------------------------------------
+#
+# The digit-polynomial product is computed by evaluation at the NCOLS
+# points x = 0..100 modulo a set of small primes (all matmuls against
+# constant matrices -> MXU), pointwise products on residues, Lagrange
+# interpolation back to columns (matmul), and an exact CRT recombination.
+# Two plans: 3 primes for single products (mul/sqr: column sums <=
+# 51*256^2 = 3,342,336 < M3), 4 primes for tower-level domain
+# combination (sums of up to ~64 products plus non-negativity offsets,
+# see tower.py).
+
+
+class _NttPlan:
+    """Constant matrices + CRT split tables for one small-prime set.
+
+    All device constants are exact small integers: forward/inverse matrix
+    entries are centered residues (|.| <= p/2 < 128, bf16-exact); CRT
+    tables are base-256 digits (< 256)."""
+
+    def __init__(self, primes):
+        self.primes = tuple(primes)
+        self.n_p = len(primes)
+        M = 1
+        for p in primes:
+            M *= p
+        self.M = M
+        pts = list(range(NCOLS))
+
+        def center(v, p):
+            v %= p
+            return float(v - p) if v > p // 2 else float(v)
+
+        v_blocks, w_blocks = [], []
+        for p in primes:
+            inv_crt = pow((M // p) % p, -1, p)
+            # Forward: V[i, k] = pts[k]^i mod p (centered).
+            V = np.zeros((W_IN, NCOLS), dtype=np.float32)
+            for k, x in enumerate(pts):
+                acc = 1
+                for i in range(W_IN):
+                    V[i, k] = center(acc, p)
+                    acc = acc * x % p
+            v_blocks.append(V)
+            # Inverse (Lagrange): monic node poly A(z) = prod (z - x_k),
+            # L_k = (A / (z - x_k)) / A'(x_k); W[k, i] = coeff_i(L_k) *
+            # (M/p)^-1, centered — the CRT weight rides the matrix.
+            poly = [1]
+            for x in pts:
+                nxt = [0] * (len(poly) + 1)
+                for i, c in enumerate(poly):
+                    nxt[i + 1] = (nxt[i + 1] + c) % p
+                    nxt[i] = (nxt[i] - c * x) % p
+                poly = nxt
+            W = np.zeros((NCOLS, NCOLS), dtype=np.float32)
+            for k, x in enumerate(pts):
+                q = [0] * NCOLS                 # A / (z - x_k)
+                q[NCOLS - 1] = poly[NCOLS]
+                for i in range(NCOLS - 2, -1, -1):
+                    q[i] = (poly[i + 1] + x * q[i + 1]) % p
+                denom = 1
+                for j, xo in enumerate(pts):
+                    if j != k:
+                        denom = denom * (x - xo) % p
+                scale = pow(denom, -1, p) * inv_crt % p
+                for i in range(NCOLS):
+                    W[k, i] = center(q[i] * scale % p, p)
+            w_blocks.append(W)
+
+        self.v_all = jnp.asarray(
+            np.concatenate(v_blocks, axis=1), dtype=jnp.bfloat16
+        )                                                   # (W_IN, n_p*N)
+        # Per-prime inverse matrices (plain dots: XLA:CPU's thunk runtime
+        # has no BATCHED bf16 dot, and n_p separate MXU matmuls schedule
+        # just as well on TPU).
+        self.w_blocks = [
+            jnp.asarray(w, dtype=jnp.bfloat16) for w in w_blocks
+        ]
+        p_arr = np.asarray(primes, dtype=np.float32)
+        self.p_col = jnp.asarray(p_arr[:, None], dtype=DTYPE)      # (n_p, 1)
+        self.inv_p_col = jnp.asarray(1.0 / p_arr[:, None], dtype=DTYPE)
+
+        # CRT split tables: m_j = M/p_j and M itself as base-256 digits.
+        # NL limbs hold M (M < 256^NL); S = sum_j gamma_j * m_j needs one
+        # extra signed top limb.
+        self.NL = (M.bit_length() + 7) // 8
+        md = np.zeros((self.n_p, self.NL), dtype=np.float32)
+        for j, p in enumerate(primes):
+            m = M // p
+            for l in range(self.NL):
+                md[j, l] = (m >> (8 * l)) & 0xFF
+        self.m_digits = md                                   # host-side np
+        self.M_digits = np.asarray(
+            [(M >> (8 * l)) & 0xFF for l in range(self.NL)], dtype=np.float32
+        )
+        self.inv_M = float(np.float64(1.0) / np.float64(M))
+
+
+_PLAN3 = _NttPlan((239, 241, 251))
+_PLAN4 = None
+
+
+def plan4() -> _NttPlan:
+    """The 4-prime plan for tower-level NTT-domain combination (sums of
+    many products need M4 ~ 2^31.6 of column headroom). Built lazily:
+    plain mul/sqr only ever needs _PLAN3."""
+    global _PLAN4
+    if _PLAN4 is None:
+        _PLAN4 = _NttPlan((233, 239, 241, 251))
+    return _PLAN4
+
+
+def ntt_fwd(x, plan=_PLAN3):
+    """Squeezed digits (..., W_IN) in [0, 256] -> centered residues
+    (..., n_p, NCOLS), |r| <= 127.
+
+    Matmul bound: 51 * 256 * 127 < 2^21 (f32-exact accumulation of
+    bf16-exact operands); centering r = e - p*round(e*(1/p)) is exact
+    (|e| < 2^22, quotient < 2^14, products < 2^22) and |r| <= p/2 +
+    0.003p <= 127."""
+    e = jax.lax.dot_general(
+        x.astype(jnp.bfloat16), plan.v_all,
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=DTYPE,
+    )
+    e = e.reshape(e.shape[:-1] + (plan.n_p, NCOLS))
+    return e - plan.p_col * jnp.round(e * plan.inv_p_col)
+
+
+def ntt_center(x, plan=_PLAN3):
+    """Re-center domain residues mod each prime (exact for |x| < 2^22)."""
+    return x - plan.p_col * jnp.round(x * plan.inv_p_col)
+
+
+def _crt_renorm(limbs):
+    """Ripple lower limbs into [0, 256), exact signed floor carries; the
+    top limb absorbs the final carry (stays signed)."""
+    out = []
+    carry = 0.0
+    for v in limbs[:-1]:
+        v = v + carry
+        c = jnp.floor(v * _INV_RADIX)
+        out.append(v - c * RADIX)
+        carry = c
+    out.append(limbs[-1] + carry)
+    return out
+
+
+def ntt_inv_cols(prod, plan=_PLAN3):
+    """Centered domain residues (..., n_p, NCOLS) of a product polynomial
+    -> exact non-negative column digits (..., NCOLS + NL) for _reduce.
+
+    Requires the true column integers of the represented polynomial to
+    lie in [0, plan.M): single squeezed products give [0, 51*256^2] and
+    M3 = 14,457,349; domain combinations must budget their sums (and add
+    a non-negativity offset polynomial) against M4 ~ 2^31.6.
+
+    Inverse matmul: |entries| <= 127 both sides, 101 terms -> |out| <
+    2^21 exact; gamma_j = center(out) recombines via the base-256 split
+    S_l = sum_j gamma_j * digit_l(M/p_j) (|S_l| <= n_p*127*255 < 2^17.6).
+    The quotient t = floor(S/M) (|t| <= 3) is estimated from a float
+    reconstruction of S (error << M) and pinned exactly by one add-M and
+    one subtract-M correction guarded by exact limb comparisons."""
+    pb = prod.astype(jnp.bfloat16)
+    gs = []
+    for j, p in enumerate(plan.primes):
+        gj = jax.lax.dot_general(
+            pb[..., j, :], plan.w_blocks[j],
+            (((prod.ndim - 2,), (0,)), ((), ())),
+            preferred_element_type=DTYPE,
+        )
+        gs.append(gj - float(p) * jnp.round(gj * float(1.0 / p)))
+    nl = plan.NL
+    # S limbs: one per M digit plus a signed top.
+    S = [
+        sum(gs[j] * float(plan.m_digits[j, l]) for j in range(plan.n_p))
+        for l in range(nl)
+    ]
+    S.append(jnp.zeros_like(S[0]))
+    S = _crt_renorm(S)
+    s_f = sum(s * float(256.0 ** l) for l, s in enumerate(S))
+    t = jnp.floor(s_f * plan.inv_M)
+    md = list(plan.M_digits) + [0.0]
+    r = _crt_renorm([s - t * float(m) for s, m in zip(S, md)])
+    neg = (r[-1] < 0).astype(DTYPE)
+    r = _crt_renorm([v + neg * float(m) for v, m in zip(r, md)])
+    # r >= M ? (lexicographic compare over the NL digits; top spare is 0)
+    ge = r[-1] > 0
+    eq_run = r[-1] == 0
+    for l in range(nl - 1, 0, -1):
+        ge = ge | (eq_run & (r[l] > float(md[l])))
+        eq_run = eq_run & (r[l] == float(md[l]))
+    ge = (ge | (eq_run & (r[0] >= float(md[0])))).astype(DTYPE)
+    r = _crt_renorm([v - ge * float(m) for v, m in zip(r, md)])
+    # Assemble columns: limb l of column k lands at column k + l.
+    nd = r[0].ndim
+    parts = []
+    for l, v in enumerate(r):
+        pad = [(0, 0)] * (nd - 1) + [(l, nl - l)]
+        parts.append(jnp.pad(v, pad))
+    return sum(parts)
+
+
+# --- Domain-combination helpers (tower.py NTT-domain multiplies) ----------------
+
+
+def ntt_fwd_lazy(x, plan=_PLAN3):
+    """Lazy limb element(s) (..., L) -> centered domain residues
+    (..., n_p, NCOLS): squeeze + forward evaluation."""
+    return ntt_fwd(_squeeze(x), plan)
+
+
+def _build_offset_dom(plan, shift_bits: int):
+    """Domain transform of a NON-NEGATIVITY offset polynomial: columns
+    d_k = 2^shift + e_k (k < NCOLS) whose value is a multiple of p (e is
+    the canonical-digit remainder making it so). Added in-domain before
+    interpolation, it shifts every true column of a signed combination
+    into [0, M) without changing the represented value mod p. The caller
+    budgets: combination columns in (-2^shift, M - 2^shift - 2^381-ish)."""
+    E = sum(1 << (B * k) for k in range(NCOLS))
+    base = 1 << shift_bits
+    V = (base * E // P + 1) * P
+    e = V - base * E
+    assert 0 <= e < P
+    digits = [base + ((e >> (8 * k)) & 0xFF) for k in range(NCOLS)]
+    arr = np.zeros((plan.n_p, NCOLS), dtype=np.float32)
+    for j, p in enumerate(plan.primes):
+        for point in range(NCOLS):
+            acc, xp = 0, 1
+            for i in range(NCOLS):
+                acc = (acc + digits[i] * xp) % p
+                xp = xp * point % p
+            c = acc if acc <= p // 2 else acc - p
+            arr[j, point] = float(c)
+    return jnp.asarray(arr, dtype=DTYPE)
+
+
+# Offsets sized to the tower's schoolbook combination bounds (tower.py):
+#   plan3 (fp2 mul): columns in [-51*256^2, 2*51*256^2]; 2^22 dominates
+#     the negative side and 2^22 + 2*3.34M + p < M3.
+#   plan4 (fp6/fp12 mul): worst column magnitude ~81 * 51*256^2 < 2.8e8;
+#     2^29 dominates and 2^29 + 2.8e8 + p-part < M4 = 3.37e9.
+_OFFSET_DOM3 = None
+_OFFSET_DOM4 = None
+
+
+def offset_dom3():
+    global _OFFSET_DOM3
+    if _OFFSET_DOM3 is None:
+        _OFFSET_DOM3 = _build_offset_dom(_PLAN3, 22)
+    return _OFFSET_DOM3
+
+
+def offset_dom4():
+    global _OFFSET_DOM4
+    if _OFFSET_DOM4 is None:
+        _OFFSET_DOM4 = _build_offset_dom(plan4(), 29)
+    return _OFFSET_DOM4
+
+
+def ntt_dom_to_limbs(c, plan, offset_dom):
+    """Signed domain combination -> loose-canonical limbs (..., L): add
+    the non-negativity offset, center, interpolate, reduce. The caller
+    guarantees its combination's true columns + offset lie in [0, M)."""
+    return _reduce(ntt_inv_cols(ntt_center(c + offset_dom, plan), plan))
+
+
 # --- Core multiply --------------------------------------------------------------
+
+_ENGINE = os.environ.get("LIGHTHOUSE_TPU_MUL_ENGINE", "ntt")
 
 
 def _col_product(a, b):
-    """Schoolbook product as 2*W_IN-1 column sums (no carries), via a
-    Toeplitz gather of b against a. Operands: digits in [0, 256], so each
-    column sum is an exact-integer f32 of magnitude <= 51*256^2 < 2^22.
-    """
+    """Round-2 schoolbook fallback: product as 2*W_IN-1 column sums (no
+    carries), via a Toeplitz gather of b against a. Operands: digits in
+    [0, 256], so each column sum is an exact-integer f32 of magnitude
+    <= 51*256^2 < 2^22. Elementwise on the VPU — kept for A/B probing
+    (LIGHTHOUSE_TPU_MUL_ENGINE=schoolbook)."""
     tb = b[..., COL_IDX] * COL_MASK            # (..., NCOLS, W_IN)
     return jnp.sum(tb * a[..., None, :], axis=-1)
 
@@ -240,16 +554,24 @@ def _col_product(a, b):
 def mul(a, b):
     """Field multiply (plain representation): value(out) == a*b mod p.
     Accepts lazy inputs (contract at module top); output loose-canonical."""
+    a, b = jnp.broadcast_arrays(a, b)
     na = _squeeze(a)
     nb = _squeeze(b)
-    return _reduce(_col_product(na, nb))
+    if _ENGINE == "schoolbook":
+        return _reduce(_col_product(na, nb))
+    fa = ntt_fwd(na)
+    fb = ntt_fwd(nb)
+    return _reduce(ntt_inv_cols(ntt_center(fa * fb)))
 
 
 def sqr(a):
-    """Squaring: one squeeze instead of two (the column product reuses
+    """Squaring: one squeeze/forward instead of two (the product reuses
     the normalized operand)."""
     na = _squeeze(a)
-    return _reduce(_col_product(na, na))
+    if _ENGINE == "schoolbook":
+        return _reduce(_col_product(na, na))
+    fa = ntt_fwd(na)
+    return _reduce(ntt_inv_cols(ntt_center(fa * fa)))
 
 
 # Interface names kept from round 1 (see module docstring).

@@ -30,6 +30,7 @@ instead of re-using them ring-buffer style.
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Set, Tuple
@@ -272,8 +273,10 @@ class Slasher:
             SlasherPersistence,
         )
 
-        self.cfg = config or SlasherConfig()
-        if history_epochs:
+        # Copy before overriding: a SlasherConfig shared across instances
+        # must not be mutated (and history_epochs=0 means 0, not default).
+        self.cfg = dataclasses.replace(config) if config else SlasherConfig()
+        if history_epochs is not None:
             self.cfg.history_length = history_epochs
         self.history = self.cfg.history_length
         self._lock = threading.Lock()

@@ -22,6 +22,10 @@ os.environ.setdefault("JAX_ENABLE_X64", "1")
 # serialization segfaults sporadically in long many-module processes; the
 # cache is populated by scripts/warm_cache.py instead.
 os.environ.setdefault("LIGHTHOUSE_TPU_JAX_CACHE_READONLY", "1")
+# Small batches must still exercise the JAX device kernels in tests (the
+# production default routes <=16 sets to the native CPU verifier;
+# tests/test_native_bls.py re-enables it explicitly).
+os.environ.setdefault("LIGHTHOUSE_TPU_CPU_FALLBACK_MAX", "0")
 
 import jax  # noqa: E402
 
