@@ -1,0 +1,122 @@
+"""Snappy codec (block + framing format) over the native C++ library.
+
+The wire-interop compression of the consensus network stack: Req/Resp
+chunks are snappy FRAMING-format streams, gossip message payloads are
+snappy BLOCK-format (reference lighthouse_network/src/rpc/protocol.rs
+ssz_snappy; types/pubsub.rs). Implemented from the public snappy format
+description in native/src/snappy.cpp and loaded via ctypes — no external
+dependency.
+"""
+
+import ctypes
+from typing import Optional
+
+from lighthouse_tpu.native import load
+
+_lib = None
+
+
+def _get():
+    global _lib
+    if _lib is None:
+        lib = load("snappy")
+        for f in ("snappy_block_compress", "snappy_block_decompress",
+                  "snappy_frame_compress", "snappy_frame_decompress",
+                  "snappy_block_uncompressed_length"):
+            getattr(lib, f).restype = ctypes.c_int64
+        lib.snappy_max_compressed_length.restype = ctypes.c_uint64
+        lib.snappy_frame_max_compressed_length.restype = ctypes.c_uint64
+        lib.snappy_crc32c_masked.restype = ctypes.c_uint32
+        _lib = lib
+    return _lib
+
+
+class SnappyError(ValueError):
+    pass
+
+
+def compress(data: bytes) -> bytes:
+    """Block format (gossip payloads)."""
+    lib = _get()
+    cap = lib.snappy_max_compressed_length(len(data))
+    out = ctypes.create_string_buffer(cap)
+    n = lib.snappy_block_compress(data, len(data), out, cap)
+    if n < 0:
+        raise SnappyError("snappy block compression failed")
+    return out.raw[:n]
+
+
+def decompress(data: bytes, max_len: int) -> bytes:
+    """Block format with an explicit decoded-size cap (bomb guard)."""
+    lib = _get()
+    n = lib.snappy_block_uncompressed_length(data, len(data))
+    if n < 0 or n > max_len:
+        raise SnappyError("snappy block length invalid or over cap")
+    out = ctypes.create_string_buffer(max(int(n), 1))
+    got = lib.snappy_block_decompress(data, len(data), out, n)
+    if got < 0:
+        raise SnappyError("malformed snappy block")
+    return out.raw[:got]
+
+
+def frame_compress(data: bytes) -> bytes:
+    """Framing format (Req/Resp chunk payloads)."""
+    lib = _get()
+    cap = lib.snappy_frame_max_compressed_length(len(data))
+    out = ctypes.create_string_buffer(cap)
+    n = lib.snappy_frame_compress(data, len(data), out, cap)
+    if n < 0:
+        raise SnappyError("snappy frame compression failed")
+    return out.raw[:n]
+
+
+def frame_decompress(data: bytes, max_len: int) -> bytes:
+    """Framing format with a decoded-size cap."""
+    lib = _get()
+    out = ctypes.create_string_buffer(max(max_len, 1))
+    n = lib.snappy_frame_decompress(data, len(data), out, max_len)
+    if n == -3:
+        raise SnappyError("snappy frame CRC mismatch")
+    if n == -2:
+        raise SnappyError("snappy frame decompresses over the size cap")
+    if n < 0:
+        raise SnappyError("malformed snappy framed stream")
+    return out.raw[:n]
+
+
+def _chunk_uncompressed_size(t: int, payload: bytes) -> int:
+    if t == 0x01:
+        return max(len(payload) - 4, 0)
+    lib = _get()
+    inner = payload[4:]
+    n = lib.snappy_block_uncompressed_length(inner, len(inner))
+    if n < 0:
+        raise SnappyError("malformed snappy chunk header")
+    return int(n)
+
+
+def frame_stream_length(data: bytes, expected: int = 0) -> Optional[int]:
+    """Byte length of the framed stream at the head of `data` carrying
+    `expected` uncompressed bytes (chunk headers are self-delimiting;
+    payloads over 64 KiB span several data chunks), or None if the buffer
+    is incomplete. Used by streaming decoders to find frame boundaries."""
+    pos = 0
+    seen_id = False
+    decoded = 0
+    while pos + 4 <= len(data):
+        t = data[pos]
+        ln = data[pos + 1] | (data[pos + 2] << 8) | (data[pos + 3] << 16)
+        if pos + 4 + ln > len(data):
+            return None
+        payload = data[pos + 4:pos + 4 + ln]
+        pos += 4 + ln
+        if t == 0xFF:
+            seen_id = True
+            if expected == 0:
+                return pos
+            continue
+        if t in (0x00, 0x01):
+            decoded += _chunk_uncompressed_size(t, payload)
+            if decoded >= expected:
+                return pos
+    return pos if (seen_id and expected == 0) else None

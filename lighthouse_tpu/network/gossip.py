@@ -17,6 +17,8 @@ sockets).
 from __future__ import annotations
 
 import hashlib
+
+from lighthouse_tpu.common import snappy as _snappy
 import random
 import threading
 from collections import OrderedDict
@@ -32,8 +34,34 @@ IGNORE = "ignore"
 REJECT = "reject"
 
 
-def message_id(topic: str, data: bytes) -> bytes:
-    return hashlib.sha256(topic.encode() + b"\x00" + data).digest()[:20]
+MESSAGE_DOMAIN_INVALID_SNAPPY = b"\x00\x00\x00\x00"
+MESSAGE_DOMAIN_VALID_SNAPPY = b"\x01\x00\x00\x00"
+MAX_GOSSIP_SIZE = 10 * 1024 * 1024
+
+
+def _id_from_body(topic: str, body: bytes, domain: bytes) -> bytes:
+    t = topic.encode()
+    pre = domain + len(t).to_bytes(8, "little") + t + body
+    return hashlib.sha256(pre).digest()[:20]
+
+
+def message_id(topic: str, wire_data: bytes) -> bytes:
+    """Altair gossip message-id (consensus spec p2p-interface): SHA256 of
+    domain || uint64_le(len(topic)) || topic || message, where message is
+    the snappy-DECOMPRESSED payload under the valid-snappy domain and the
+    raw payload under the invalid one. Matches the reference's
+    gossip_message_id_fn (lighthouse_network/src/service/utils.rs).
+
+    SELF-COMPUTED on both publish and receive: the id is a pure function
+    of (topic, data), never trusted from the wire — a peer cannot
+    pre-claim another message's id with junk bytes to censor it."""
+    try:
+        body = _snappy.decompress(wire_data, MAX_GOSSIP_SIZE)
+        domain = MESSAGE_DOMAIN_VALID_SNAPPY
+    except _snappy.SnappyError:
+        body = wire_data
+        domain = MESSAGE_DOMAIN_INVALID_SNAPPY
+    return _id_from_body(topic, body, domain)
 
 
 class SimTransport:
@@ -129,9 +157,14 @@ class GossipNode:
     # --------------------------------------------------------------- publish
 
     def publish(self, topic: str, data: bytes) -> int:
-        """Publish; returns the number of peers the message went to."""
+        """Publish; returns the number of peers the message went to. The
+        wire payload is snappy BLOCK-compressed (the ssz_snappy gossip
+        encoding, types/pubsub.rs); handlers receive the decompressed
+        application bytes."""
+        body = data
+        data = _snappy.compress(data)
         with self._lock:
-            mid = message_id(topic, data)
+            mid = _id_from_body(topic, body, MESSAGE_DOMAIN_VALID_SNAPPY)
             self._mark_seen(mid)
             if topic in self.subscriptions:
                 targets = set(self.mesh.get(topic, set()))
@@ -172,7 +205,17 @@ class GossipNode:
                 self._handle_gossip(src, frame)
 
     def _handle_gossip(self, src: str, frame: tuple) -> None:
-        _, topic, mid, data, origin = frame
+        _, topic, _claimed_mid, data, origin = frame
+        # The message id is RECOMPUTED from the payload (see message_id):
+        # the claimed id is ignored, so junk data cannot poison the seen
+        # cache against a future legitimate message.
+        try:
+            body = _snappy.decompress(data, MAX_GOSSIP_SIZE)
+        except _snappy.SnappyError:
+            # Invalid-snappy payloads are spec-REJECTed (penalize sender).
+            self.peer_manager.report_peer(src, PeerAction.LOW_TOLERANCE)
+            return
+        mid = _id_from_body(topic, body, MESSAGE_DOMAIN_VALID_SNAPPY)
         if mid in self._seen:
             return
         self._mark_seen(mid)
@@ -182,7 +225,7 @@ class GossipNode:
         validator = self.validators.get(topic)
         if validator is not None:
             try:
-                verdict = validator(topic, data, origin)
+                verdict = validator(topic, body, origin)
             except Exception:
                 verdict = REJECT
         if verdict == REJECT:
@@ -192,7 +235,7 @@ class GossipNode:
             return
         handler = self.handlers.get(topic)
         if handler is not None:
-            handler(topic, data, origin)
+            handler(topic, body, origin)
         # forward to the mesh (except where it came from)
         for p in self.mesh.get(topic, set()):
             if p != src and p != origin:

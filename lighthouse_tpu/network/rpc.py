@@ -4,7 +4,9 @@ Mirror of lighthouse_network/src/rpc/: protocol-tagged requests, chunked
 responses (BlocksByRange streams one block per chunk), per-peer token-bucket
 rate limiting on both inbound (rate_limiter.rs) and outbound
 (self_limiter.rs), and error codes. Frames ride the same transport as
-gossip; payloads use the zlib framing seam from types.py.
+gossip; payloads and response chunks use the reference's ssz_snappy wire
+encoding (uvarint length + snappy framing, one-byte response codes —
+rpc/codec/) via types.py.
 """
 
 from __future__ import annotations
@@ -15,7 +17,13 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .peer_manager import PeerAction
-from .types import Protocol, decode_frame, encode_frame
+from .types import (
+    Protocol,
+    decode_frame,
+    decode_response_chunk,
+    encode_frame,
+    encode_response_chunk,
+)
 
 RESP_SUCCESS = 0
 RESP_INVALID_REQUEST = 1
@@ -118,11 +126,28 @@ class RpcHandler:
         kind = frame[0]
         if kind == "rpc_req":
             _, req_id, protocol, enc = frame
-            payload, _ = decode_frame(enc)
+            try:
+                payload, _ = decode_frame(enc)
+            except ValueError:
+                payload = None
+            if payload is None:
+                # Malformed/truncated ssz_snappy request: invalid-request
+                # response + peer penalty (codec error handling shape of
+                # rpc/codec/ssz_snappy.rs).
+                self._respond(src, req_id, RESP_INVALID_REQUEST,
+                              b"malformed request framing")
+                self.transport.send(self.peer_id, src, ("rpc_end", req_id))
+                if self.peer_manager is not None:
+                    self.peer_manager.report_peer(
+                        src, PeerAction.LOW_TOLERANCE)
+                return
             self._serve(src, req_id, protocol, payload)
         elif kind == "rpc_resp":
-            _, req_id, code, enc = frame
-            data, _ = decode_frame(enc) if enc else (b"", 0)
+            _, req_id, chunk = frame
+            try:
+                code, data, _ = decode_response_chunk(chunk)
+            except ValueError:
+                return  # malformed chunk: drop
             with self._lock:
                 # Responses only count from the peer the request went to —
                 # req_ids are sequential and trivially guessable, so any
@@ -162,7 +187,8 @@ class RpcHandler:
 
     def _respond(self, dst: str, req_id: int, code: int, data: bytes) -> None:
         self.transport.send(
-            self.peer_id, dst, ("rpc_resp", req_id, code, encode_frame(data))
+            self.peer_id, dst,
+            ("rpc_resp", req_id, encode_response_chunk(code, data)),
         )
 
     def _rate_ok(self, peer: str, protocol: str) -> bool:

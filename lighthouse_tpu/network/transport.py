@@ -10,14 +10,22 @@ real frames (reference shape: lighthouse_network/src/rpc/protocol.rs
 length-prefixed ssz_snappy framing; service/utils.rs transport build).
 
 Wire format (one message):
-    4-byte big-endian length || zlib(wire-encoded envelope)
+    4-byte big-endian length || snappy-framed(wire-encoded envelope)
     envelope := ("hello", peer_id, listen_host, listen_port)
               | ("frame", src_peer_id, frame_tuple)
 
-The frame payload codec is a small tagged binary encoding of the Python
-frame tuples the protocol layers already exchange (str/bytes/int/bool/
-None/tuple/list) — the seam where full ssz_snappy interop framing would
-slot in for talking to other client implementations.
+Round 3: the compression is the snappy FRAMING format (the reference's
+transport-level codec family), via the native C++ snappy; RPC payloads
+inside the frames additionally carry the reference's exact ssz_snappy
+chunk encoding (types.py). The envelope itself remains a small tagged
+binary encoding of the Python frame tuples the protocol layers exchange.
+
+Identity rules (round-3 ADVICE fix): inbound frames are attributed to the
+AUTHENTICATED connection identity from the hello handshake — the in-band
+`src` field is checked and mismatches dropped, so no connected peer can
+impersonate another (inject RPC response chunks / early rpc_end, or
+misattribute gossip for scoring). A hello claiming an already-connected
+peer id (or our own) is rejected instead of evicting the live connection.
 """
 
 from __future__ import annotations
@@ -25,8 +33,9 @@ from __future__ import annotations
 import socket
 import struct
 import threading
-import zlib
 from typing import Callable, Dict, Optional, Tuple
+
+from lighthouse_tpu.common import snappy as _snappy
 
 MAX_FRAME = 32 * 1024 * 1024  # hard cap, matches the reference's chunk caps
 
@@ -112,7 +121,7 @@ def decode_wire(data: bytes):
 
 
 def _pack(obj) -> bytes:
-    body = zlib.compress(encode_wire(obj))
+    body = _snappy.frame_compress(encode_wire(obj))
     if len(body) > MAX_FRAME:
         raise ValueError("frame too large")
     return struct.pack(">I", len(body)) + body
@@ -129,13 +138,13 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
 
 
 def _decompress_capped(body: bytes) -> bytes:
-    """zlib with a DECODED-size cap — the length prefix only bounds the
-    compressed size, and a decompression bomb must not OOM the node."""
-    d = zlib.decompressobj()
-    out = d.decompress(body, MAX_FRAME)
-    if d.unconsumed_tail or (d.decompress(b"", 1) != b""):
-        raise ValueError("frame decompresses over the size cap")
-    return out
+    """Snappy framing with a DECODED-size cap — the length prefix only
+    bounds the compressed size, and a decompression bomb must not OOM the
+    node (the codec enforces the cap chunk by chunk)."""
+    try:
+        return _snappy.frame_decompress(body, MAX_FRAME)
+    except _snappy.SnappyError as e:
+        raise ValueError(str(e))
 
 
 def _recv_msg(sock: socket.socket):
@@ -203,7 +212,7 @@ class TcpTransport:
             raise ConnectionError("bad hello from peer")
         _, remote_id, rhost, rport = msg
         sock.settimeout(None)
-        self._add_conn(remote_id, sock, (rhost, rport))
+        self._add_conn(remote_id, sock, (rhost, rport), outbound=True)
         return remote_id
 
     def _accept_loop(self) -> None:
@@ -227,8 +236,8 @@ class TcpTransport:
             sock.sendall(_pack(("hello", self.peer_id,
                                 self.listen_addr[0], self.listen_addr[1])))
             sock.settimeout(None)
-            self._add_conn(remote_id, sock, (rhost, rport))
-        except (OSError, ValueError, zlib.error, struct.error, IndexError):
+            self._add_conn(remote_id, sock, (rhost, rport), outbound=False)
+        except (OSError, ValueError, struct.error, IndexError):
             # Garbage hellos (port scanners, bad peers) must not leak the
             # socket or kill the handshake thread.
             try:
@@ -237,11 +246,37 @@ class TcpTransport:
                 pass
 
     def _add_conn(self, remote_id: str, sock: socket.socket,
-                  addr: Tuple[str, int]) -> None:
+                  addr: Tuple[str, int], outbound: bool) -> None:
+        if remote_id == self.peer_id:
+            # A dialer claiming OUR id is either a loop or an attack.
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
+        old = None
         with self._conn_lock:
-            old = self._conns.get(remote_id)
-            self._conns[remote_id] = sock
-            self._peer_addrs[remote_id] = addr
+            existing = self._conns.get(remote_id)
+            if existing is not None and not outbound:
+                # An INBOUND hello must not evict an established connection
+                # by merely CLAIMING its peer id (ADVICE r2 impersonation
+                # fix): refuse the new socket. A genuinely restarted peer
+                # REDIALS — and our own outbound dial (below) does replace,
+                # so reconnect-after-restart works; crossing mutual dials
+                # may transiently drop both sockets, the readers notice
+                # and a redial converges.
+                dup = True
+            else:
+                dup = False
+                old = existing          # outbound replace: evict stale conn
+                self._conns[remote_id] = sock
+                self._peer_addrs[remote_id] = addr
+        if dup:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
         if old is not None:
             try:
                 old.close()
@@ -261,12 +296,14 @@ class TcpTransport:
                     break
                 if isinstance(msg, tuple) and msg and msg[0] == "frame":
                     _, src, frame = msg
+                    if src != remote_id:
+                        continue  # impersonation attempt: drop (ADVICE r2)
                     if self.node is not None:
                         try:
-                            self.node.handle_frame(src, frame)
+                            self.node.handle_frame(remote_id, frame)
                         except Exception:
                             pass  # a bad frame must not kill the reader
-        except (OSError, ValueError, zlib.error, struct.error, IndexError):
+        except (OSError, ValueError, struct.error, IndexError):
             pass
         finally:
             with self._conn_lock:
@@ -330,6 +367,9 @@ class UdpTransport:
         self._sock.bind((host, port))
         self.listen_addr = self._sock.getsockname()
         self._addrs: Dict[str, Tuple[str, int]] = {}
+        self._last_seen: Dict[str, float] = {}
+        self.REBIND_AFTER = 30.0   # seconds of silence before a new
+                                   # source address may claim a peer id
         self._lock = threading.Lock()
         self._closed = False
         self._thread = threading.Thread(target=self._recv_loop, daemon=True)
@@ -352,7 +392,7 @@ class UdpTransport:
             addr = self._addrs.get(dst)
         if addr is None:
             return
-        pkt = zlib.compress(encode_wire(
+        pkt = _snappy.frame_compress(encode_wire(
             ("pkt", src, self.listen_addr[0], self.listen_addr[1], frame)
         ))
         if len(pkt) > 65000:
@@ -370,15 +410,35 @@ class UdpTransport:
                 return
             try:
                 msg = decode_wire(_decompress_capped(data))
-            except (ValueError, zlib.error, struct.error, IndexError):
+            except (ValueError, struct.error, IndexError):
                 continue
             if not (isinstance(msg, tuple) and len(msg) == 5
                     and msg[0] == "pkt"):
                 continue
             _, src, shost, sport, frame = msg
-            # Learn/refresh the sender's address from the packet itself.
+            if src == self.peer_id:
+                continue  # a datagram claiming OUR id: drop
+            # Bind the claimed id to the OBSERVED source address (not the
+            # announced one): an off-path spoofer cannot receive replies,
+            # and an id already bound to a DIFFERENT address is dropped
+            # (ADVICE r2 — discovery has no handshake channel, so address
+            # pinning is the available spoof guard). The binding EXPIRES after
+            # REBIND_AFTER seconds of silence so a peer that moved (or a
+            # racing first-claim by an attacker) cannot eclipse the id
+            # forever — the legitimate peer re-binds once the stale entry
+            # ages out.
+            import time as _time
+            now = _time.monotonic()
             with self._lock:
-                self._addrs[src] = (shost, sport)
+                bound = self._addrs.get(src)
+                if bound is None or bound == addr:
+                    self._addrs[src] = addr
+                    self._last_seen[src] = now
+                elif now - self._last_seen.get(src, 0.0) > self.REBIND_AFTER:
+                    self._addrs[src] = addr
+                    self._last_seen[src] = now
+                else:
+                    continue
             if self.node is not None:
                 try:
                     self.node.handle_frame(src, frame)

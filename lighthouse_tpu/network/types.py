@@ -5,18 +5,21 @@ fork-digest-scoped names incl. 64 attestation subnets + 4 sync subnets),
 `PubsubMessage` (types/pubsub.rs), Req/Resp protocol ids
 (rpc/protocol.rs:152-177), `Status` handshake and `MetaData`.
 
-Framing note: the reference compresses frames with snappy; this stack uses
-zlib (stdlib) behind the same length-prefixed shape — the seam
-(`encode_frame`/`decode_frame`) is where a snappy codec would slot in for
-mainnet interop.
+Framing (round 3): payloads use the REFERENCE wire format — ssz_snappy:
+a protobuf-style uvarint of the SSZ length followed by a snappy
+FRAMING-format stream (rpc/protocol.rs:152-232, rpc/codec/). Response
+chunks prepend the one-byte result code. Gossip message data is snappy
+BLOCK format (types/pubsub.rs). The snappy codec itself is the native
+C++ implementation behind lighthouse_tpu.common.snappy.
 """
 
 from __future__ import annotations
 
 import struct
-import zlib
 from dataclasses import dataclass
 from typing import Optional
+
+from lighthouse_tpu.common import snappy as _snappy
 
 ATTESTATION_SUBNET_COUNT = 64
 SYNC_COMMITTEE_SUBNET_COUNT = 4
@@ -125,7 +128,10 @@ class BlocksByRangeRequest:
     count: int
 
     def to_bytes(self) -> bytes:
-        return struct.pack("<QQ", self.start_slot, self.count)
+        # SSZ BeaconBlocksByRangeRequest keeps the deprecated `step` field
+        # on the wire (fixed at 1 in v2) — 24 bytes, byte-compatible with
+        # the reference (rpc/methods.rs).
+        return struct.pack("<QQQ", self.start_slot, self.count, 1)
 
     @classmethod
     def from_bytes(cls, b: bytes) -> "BlocksByRangeRequest":
@@ -148,19 +154,70 @@ class BlocksByRootRequest:
 # --- framing (rpc/codec/: length-prefix + compression) ----------------------
 
 
+MAX_PAYLOAD = 32 * 1024 * 1024   # matches the reference's chunk caps
+
+
+def encode_uvarint(n: int) -> bytes:
+    out = bytearray()
+    while n >= 0x80:
+        out.append((n & 0x7F) | 0x80)
+        n >>= 7
+    out.append(n)
+    return bytes(out)
+
+
+def decode_uvarint(data: bytes, pos: int = 0):
+    """-> (value, next_pos) or (None, pos) when incomplete."""
+    v, shift = 0, 0
+    while pos < len(data) and shift <= 63:
+        b = data[pos]
+        pos += 1
+        v |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return v, pos
+        shift += 7
+    return None, pos
+
+
 def encode_frame(payload: bytes) -> bytes:
-    comp = zlib.compress(payload, 1)
-    return struct.pack("<I", len(comp)) + comp
+    """ssz_snappy payload framing: uvarint(len) || snappy-frames(payload)
+    — byte-identical to the reference's Req/Resp chunk payload encoding
+    (rpc/codec/ssz_snappy.rs)."""
+    return encode_uvarint(len(payload)) + _snappy.frame_compress(payload)
 
 
 def decode_frame(data: bytes) -> tuple:
-    """-> (payload, bytes_consumed) or (None, 0) if incomplete."""
-    if len(data) < 4:
+    """-> (payload, bytes_consumed) or (None, 0) if incomplete; raises on
+    malformed or over-cap framing."""
+    n, pos = decode_uvarint(data, 0)
+    if n is None:
         return None, 0
-    n = struct.unpack("<I", data[:4])[0]
-    if len(data) < 4 + n:
+    if n > MAX_PAYLOAD:
+        raise ValueError("ssz_snappy length over cap")
+    stream_len = _snappy.frame_stream_length(data[pos:], n)
+    if stream_len is None:
         return None, 0
-    return zlib.decompress(data[4:4 + n]), 4 + n
+    payload = _snappy.frame_decompress(data[pos:pos + stream_len], n)
+    if len(payload) != n:
+        raise ValueError("ssz_snappy length mismatch")
+    return payload, pos + stream_len
+
+
+def encode_response_chunk(code: int, payload: bytes) -> bytes:
+    """Req/Resp response chunk: <result byte> || uvarint || snappy frames
+    (rpc/codec/: the one-byte response code precedes each SSZ chunk)."""
+    return bytes([code]) + encode_frame(payload)
+
+
+def decode_response_chunk(data: bytes) -> tuple:
+    """-> (code, payload, consumed); raises on malformed chunks."""
+    if not data:
+        raise ValueError("empty response chunk")
+    code = data[0]
+    payload, used = decode_frame(data[1:])
+    if payload is None:
+        raise ValueError("truncated response chunk")
+    return code, payload, 1 + used
 
 
 # --- goodbye / ban reasons --------------------------------------------------

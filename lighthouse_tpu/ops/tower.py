@@ -18,6 +18,8 @@ differential-test ground truth. Frobenius/sqrt constants are computed at
 import from the oracle, not memorized.
 """
 
+import os
+
 import numpy as np
 
 import jax
@@ -37,6 +39,94 @@ neg = lb.neg
 
 def _st(*parts):
     return jnp.stack(parts, axis=-2)
+
+
+# ---------------------------------------------------------------------------
+# NTT-domain combination (round 3): multiply in the evaluation domain
+# ---------------------------------------------------------------------------
+#
+# Every tower multiply used to bottom out in ONE batched lb.mont_mul, so an
+# Fp12 product paid 108 squeeze+forward transforms and 54 interpolations.
+# With the engine's domain exposed (limbs.ntt_fwd_lazy / ntt_dom_to_limbs),
+# the tower instead transforms each operand COORDINATE once (12 forwards
+# per Fp12 operand), combines the schoolbook tower formulas on residues —
+# pointwise products and adds, exact in f32 by the budgets below — and
+# interpolates only the 12 outputs. Karatsuba is deliberately NOT used in
+# the domain: pointwise products are nearly free, and schoolbook's
+# combination bounds are small.
+#
+# Budgets (C = 51*256^2, the column bound of one squeezed product):
+#   * true column integers: fp2 |.| <= 2C; fp6 <= 8C; fp12 <= 17C < 2^26
+#     — the 2^22 (plan3) / 2^29 (plan4) offset polynomials dominate the
+#     negative range and keep every column in [0, M).
+#   * f32 domain values: products <= 127^2; the deepest combination is
+#     < 2^19 << 2^24 (exact).
+#
+# LIGHTHOUSE_TPU_TOWER_NTT=0 restores the batched-Karatsuba limb paths
+# (A/B probing; differential tests run both ways).
+
+_TOWER_NTT = os.environ.get("LIGHTHOUSE_TPU_TOWER_NTT", "1") == "1"
+
+if _TOWER_NTT:
+    # Build the 4-prime plan + offset-polynomial constants EAGERLY, outside
+    # any jit trace: device constants created lazily inside a traced
+    # function would be cached as that trace's tracers and leak into the
+    # next one (observed as UnexpectedTracerError in the multichip dryrun).
+    lb.plan4()
+    lb.offset_dom3()
+    lb.offset_dom4()
+
+
+def _d2mul(a, b):
+    """Domain Fp2 schoolbook: (..., 2, n_p, N) x (..., 2, n_p, N)."""
+    a0, a1 = a[..., 0, :, :], a[..., 1, :, :]
+    b0, b1 = b[..., 0, :, :], b[..., 1, :, :]
+    return jnp.stack([a0 * b0 - a1 * b1, a0 * b1 + a1 * b0], axis=-3)
+
+
+def _d2sqr(a):
+    a0, a1 = a[..., 0, :, :], a[..., 1, :, :]
+    p = a0 * a1
+    return jnp.stack([a0 * a0 - a1 * a1, p + p], axis=-3)
+
+
+def _dxi(a):
+    """Multiply a domain Fp2 by xi = 1 + u."""
+    a0, a1 = a[..., 0, :, :], a[..., 1, :, :]
+    return jnp.stack([a0 - a1, a0 + a1], axis=-3)
+
+
+def _d6mul(A, B):
+    """Domain Fp6 schoolbook with v^3 = xi: (..., 3, 2, n_p, N)."""
+    a0, a1, a2 = A[..., 0, :, :, :], A[..., 1, :, :, :], A[..., 2, :, :, :]
+    b0, b1, b2 = B[..., 0, :, :, :], B[..., 1, :, :, :], B[..., 2, :, :, :]
+    c0 = _d2mul(a0, b0) + _dxi(_d2mul(a1, b2) + _d2mul(a2, b1))
+    c1 = _d2mul(a0, b1) + _d2mul(a1, b0) + _dxi(_d2mul(a2, b2))
+    c2 = _d2mul(a0, b2) + _d2mul(a1, b1) + _d2mul(a2, b0)
+    return jnp.stack([c0, c1, c2], axis=-4)
+
+
+def _d6mul_by_v(A):
+    return jnp.stack(
+        [_dxi(A[..., 2, :, :, :]), A[..., 0, :, :, :], A[..., 1, :, :, :]],
+        axis=-4,
+    )
+
+
+def _fwd3(x):
+    return lb.ntt_fwd_lazy(x)
+
+
+def _fwd4(x):
+    return lb.ntt_fwd_lazy(x, lb.plan4())
+
+
+def _out3(c):
+    return lb.ntt_dom_to_limbs(c, lb._PLAN3, lb.offset_dom3())
+
+
+def _out4(c):
+    return lb.ntt_dom_to_limbs(c, lb.plan4(), lb.offset_dom4())
 
 
 # ---------------------------------------------------------------------------
@@ -65,8 +155,11 @@ def _fp2_const(pair):
 
 
 def fp2_mul(a, b):
-    """Karatsuba: one batched mont_mul of [a0*b0, a1*b1, (a0+a1)(b0+b1)]."""
+    """Domain schoolbook (two forwards per operand, two interpolations);
+    Karatsuba-over-one-batched-mont_mul when LIGHTHOUSE_TPU_TOWER_NTT=0."""
     a, b = jnp.broadcast_arrays(a, b)
+    if _TOWER_NTT:
+        return _out3(_d2mul(_fwd3(a), _fwd3(b)))
     a0, a1 = a[..., 0, :], a[..., 1, :]
     b0, b1 = b[..., 0, :], b[..., 1, :]
     pre = lb.add(_st(a0, b0), _st(a1, b1))
@@ -76,7 +169,10 @@ def fp2_mul(a, b):
 
 
 def fp2_sqr(a):
-    """(a0+a1)(a0-a1) and a0*a1 in one batched mont_mul."""
+    """(a0+a1)(a0-a1) and a0*a1 in one batched mont_mul; single-forward
+    domain squaring on the NTT path."""
+    if _TOWER_NTT:
+        return _out3(_d2sqr(_fwd3(a)))
     a0, a1 = a[..., 0, :], a[..., 1, :]
     s = lb.add(a0, a1)
     d = lb.sub(a0, a1)
@@ -279,8 +375,11 @@ def _st6(*parts):
 
 
 def fp6_mul(a, b):
-    """Toom/Karatsuba: ONE batched fp2_mul over 6 stacked products."""
+    """Domain schoolbook (6 forwards per operand, 6 interpolations);
+    Toom/Karatsuba over ONE batched fp2_mul when the NTT path is off."""
     a, b = jnp.broadcast_arrays(a, b)
+    if _TOWER_NTT:
+        return _out4(_d6mul(_fwd4(a), _fwd4(b)))
     a0, a1, a2 = a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
     b0, b1, b2 = b[..., 0, :, :], b[..., 1, :, :], b[..., 2, :, :]
     pre = lb.add(
@@ -336,8 +435,19 @@ def _st12(c0, c1):
 
 
 def fp12_mul(a, b):
-    """Karatsuba: ONE batched fp6_mul over 3 stacked products."""
+    """Domain schoolbook: 12 forwards per operand, 144 pointwise products,
+    12 interpolations (vs 108 forwards + 54 interpolations for the
+    batched-Karatsuba path, kept under LIGHTHOUSE_TPU_TOWER_NTT=0)."""
     a, b = jnp.broadcast_arrays(a, b)
+    if _TOWER_NTT:
+        fa, fb = _fwd4(a), _fwd4(b)
+        A0, A1 = fa[..., 0, :, :, :, :], fa[..., 1, :, :, :, :]
+        B0, B1 = fb[..., 0, :, :, :, :], fb[..., 1, :, :, :, :]
+        t0 = _d6mul(A0, B0)
+        t1 = _d6mul(A1, B1)
+        c0 = t0 + _d6mul_by_v(t1)
+        c1 = _d6mul(A0, B1) + _d6mul(A1, B0)
+        return _out4(jnp.stack([c0, c1], axis=-5))
     a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
     b0, b1 = b[..., 0, :, :, :], b[..., 1, :, :, :]
     pre = lb.add(jnp.stack([a0, b0], axis=-4), jnp.stack([a1, b1], axis=-4))
@@ -352,6 +462,14 @@ def fp12_mul(a, b):
 
 
 def fp12_sqr(a):
+    if _TOWER_NTT:
+        fa = _fwd4(a)
+        A0, A1 = fa[..., 0, :, :, :, :], fa[..., 1, :, :, :, :]
+        t0 = _d6mul(A0, A0)
+        t1 = _d6mul(A1, A1)
+        c0 = t0 + _d6mul_by_v(t1)
+        c1 = 2.0 * _d6mul(A0, A1)
+        return _out4(jnp.stack([c0, c1], axis=-5))
     return fp12_mul(a, a)
 
 
@@ -365,6 +483,41 @@ def fp12_mul_sparse_line(a, l0, l1, l2):
     A L0 is a coefficient-wise scale (3 muls); B L1 expands with v^3 = xi to
     (xi(b1 l2 + b2 l1), b0 l1 + xi(b2 l2), b0 l2 + b1 l1) (6 muls);
     (L0+L1) is dense so the cross term is one fp6_mul (6 muls)."""
+    if _TOWER_NTT:
+        fa = _fwd4(a)                                  # (..., 2,3,2,np,N)
+        fl = _fwd4(jnp.stack([l0, l1, l2], axis=-3))   # (..., 3,2,np,N)
+        A0, A1 = fa[..., 0, :, :, :, :], fa[..., 1, :, :, :, :]
+        d0 = fl[..., 0, :, :, :]
+        d1 = fl[..., 1, :, :, :]
+        d2 = fl[..., 2, :, :, :]
+        a00, a01, a02 = (A0[..., 0, :, :, :], A0[..., 1, :, :, :],
+                         A0[..., 2, :, :, :])
+        b0, b1, b2 = (A1[..., 0, :, :, :], A1[..., 1, :, :, :],
+                      A1[..., 2, :, :, :])
+        # A0 * L0, L0 = (l0, 0, 0): coefficient-wise scale.
+        t0 = jnp.stack(
+            [_d2mul(a00, d0), _d2mul(a01, d0), _d2mul(a02, d0)], axis=-4
+        )
+        # A1 * L1, L1 = (0, l1, l2).
+        t1 = jnp.stack(
+            [_dxi(_d2mul(b1, d2) + _d2mul(b2, d1)),
+             _d2mul(b0, d1) + _dxi(_d2mul(b2, d2)),
+             _d2mul(b0, d2) + _d2mul(b1, d1)],
+            axis=-4,
+        )
+        # A0 * L1 and A1 * L0.
+        t2 = jnp.stack(
+            [_dxi(_d2mul(a01, d2) + _d2mul(a02, d1)),
+             _d2mul(a00, d1) + _dxi(_d2mul(a02, d2)),
+             _d2mul(a00, d2) + _d2mul(a01, d1)],
+            axis=-4,
+        )
+        t3 = jnp.stack(
+            [_d2mul(b0, d0), _d2mul(b1, d0), _d2mul(b2, d0)], axis=-4
+        )
+        c0 = t0 + _d6mul_by_v(t1)
+        c1 = t2 + t3
+        return _out4(jnp.stack([c0, c1], axis=-5))
     A = a[..., 0, :, :, :]
     B = a[..., 1, :, :, :]
     a0, a1, a2 = A[..., 0, :, :], A[..., 1, :, :], A[..., 2, :, :]

@@ -113,3 +113,65 @@ def test_device_batch_verify_matches_oracle(kzg):
     assert not kzg.verify_blob_kzg_proof_batch(
         blobs, commitments, bad, device=True
     )
+
+
+# --- production trusted setup (VERDICT r2 #5) -------------------------------
+
+
+@pytest.fixture(scope="module")
+def prod_kzg():
+    if not os.path.exists(Kzg.PRODUCTION_SETUP_PATH):
+        pytest.skip("production trusted setup file unavailable")
+    return Kzg.load_trusted_setup()  # validate=True: structural anchors
+
+
+def test_production_setup_loads_with_anchors(prod_kzg):
+    """4096-point ceremony setup: anchors (sum of Lagrange points == G1
+    generator; g2_monomial[0] == G2 generator) are checked inside
+    load_trusted_setup — plus basic shape/domain facts here."""
+    assert prod_kzg.n == 4096
+    # domain entries are 4096th roots of unity, bit-reverse permuted
+    w0 = prod_kzg.domain[0]
+    assert w0 == 1
+    for wi in prod_kzg.domain[:8]:
+        assert pow(wi, 4096, R) == 1
+
+
+def test_production_constant_poly_commitment(prod_kzg):
+    """Commitment of the constant polynomial c is [c]G1 — exercises the
+    real Lagrange points without a full-size MSM (sum L_i identity)."""
+    from lighthouse_tpu.crypto.bls import curves as cv
+
+    c = 123456789
+    blob = _blob([c] * prod_kzg.n)
+    commitment = prod_kzg.blob_to_kzg_commitment(blob)
+    assert commitment == cv.g1_mul(cv.G1_GEN, c)
+
+
+@pytest.mark.slow
+def test_production_setup_full_proof_cycle():
+    """Full commit/proof/verify on the PRODUCTION setup (host path): a
+    pairing-checked end-to-end cycle plus the tau-consistency anchor
+    (the X-polynomial commitment pairs against g2_monomial[1])."""
+    from lighthouse_tpu.crypto.bls import curves as cv
+    from lighthouse_tpu.crypto.bls import pairing as pr
+
+    if not os.path.exists(Kzg.PRODUCTION_SETUP_PATH):
+        pytest.skip("production trusted setup file unavailable")
+    kz = Kzg.load_trusted_setup()
+    # tau anchor: commit to f(X) = X; e(C, G2) == e(G1, [tau]G2).
+    evals = list(kz.domain)
+    cx = kz._msm(evals)
+    assert pr.pairings_product_is_one(
+        [(cx, cv.G2_GEN), (cv.g1_neg(cv.G1_GEN), kz.g2_tau)]
+    )
+    # sparse blob -> cheap commitment; full-size quotient MSM for proof.
+    vals = [0] * kz.n
+    vals[0], vals[5], vals[77] = 11, 22, 33
+    blob = _blob(vals)
+    commitment = kz.blob_to_kzg_commitment(blob)
+    proof = kz.compute_blob_kzg_proof(blob, commitment)
+    assert kz.verify_blob_kzg_proof(blob, commitment, proof)
+    bad = bytearray(blob)
+    bad[31] ^= 1
+    assert not kz.verify_blob_kzg_proof(bytes(bad), commitment, proof)
