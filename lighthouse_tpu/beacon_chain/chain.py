@@ -604,9 +604,25 @@ class BeaconChain:
             exits: list = []
             bls_changes: list = []
             deposits: list = []
+            # Eth1-data VOTE (spec get_eth1_vote over the follower's block
+            # cache; validator.md). If OUR vote would reach the period
+            # majority once appended, the state's eth1_data flips inside
+            # process_eth1_data — deposit inclusion must then track the
+            # VOTED count, not the pre-state one.
+            eth1_vote = state.eth1_data
+            if self.deposit_cache is not None and \
+                    getattr(self.deposit_cache, "blocks", None):
+                from lighthouse_tpu.eth1.deposit_cache import get_eth1_vote
+
+                eth1_vote = get_eth1_vote(state, t, spec, self.deposit_cache)
+            period_slots = (spec.preset.EPOCHS_PER_ETH1_VOTING_PERIOD *
+                            spec.preset.SLOTS_PER_EPOCH)
+            same = sum(1 for v in state.eth1_data_votes if v == eth1_vote) + 1
+            effective_eth1 = eth1_vote if same * 2 > period_slots \
+                else state.eth1_data
             # The spec REQUIRES min(MAX_DEPOSITS, pending) deposits when the
-            # state's eth1_data is ahead of its deposit index.
-            pending = state.eth1_data.deposit_count - state.eth1_deposit_index
+            # effective eth1_data is ahead of the state's deposit index.
+            pending = effective_eth1.deposit_count - state.eth1_deposit_index
             if pending > 0 and self.deposit_cache is not None:
                 start = state.eth1_deposit_index
                 end = start + min(pending, spec.preset.MAX_DEPOSITS)
@@ -620,7 +636,7 @@ class BeaconChain:
                     t.Deposit(proof=proof, data=data)
                     for data, proof in self.deposit_cache.get_deposits(
                         start, end,
-                        deposit_count=state.eth1_data.deposit_count,
+                        deposit_count=effective_eth1.deposit_count,
                     )
                 ]
             if self.op_pool is not None:
@@ -675,7 +691,7 @@ class BeaconChain:
             )
             common = dict(
                 randao_reveal=randao_reveal,
-                eth1_data=state.eth1_data,
+                eth1_data=eth1_vote,
                 graffiti=graffiti,
                 proposer_slashings=proposer_slashings,
                 attester_slashings=attester_slashings,

@@ -71,17 +71,56 @@ class DepositTree:
         what a historical eth1_data.deposit_root snapshot committed to."""
         if deposit_count > len(self.leaves):
             raise DepositCacheError("count beyond tree")
-        node = _ZERO[0]
-        layer = list(self.leaves[:deposit_count])
-        for h in range(DEPOSIT_TREE_DEPTH):
-            nxt = []
-            for i in range(0, len(layer), 2):
-                a = layer[i]
-                b = layer[i + 1] if i + 1 < len(layer) else _ZERO[h]
-                nxt.append(_sha(a, b))
-            layer = nxt
-        node = layer[0] if layer else _ZERO[DEPOSIT_TREE_DEPTH]
+        node = self._node(DEPOSIT_TREE_DEPTH, 0, deposit_count)
         return _sha(node, deposit_count.to_bytes(32, "little"))
+
+    def snapshot(self) -> dict:
+        """Finalized-tree snapshot (EIP-4881 shape): the right-edge branch
+        plus leaf count — enough to RESUME pushes, track the contract
+        root, and PROVE any deposit appended after the snapshot (the
+        finalized full-subtree roots encoded in the branch reconstruct
+        every sibling a post-snapshot proof needs). Pre-snapshot leaves
+        are pruned and can no longer be proven."""
+        return {
+            "branch": [bytes(b) for b in self._branch],
+            "count": len(self.leaves),
+        }
+
+    @classmethod
+    def from_snapshot(cls, snap: dict) -> "DepositTree":
+        t = cls.__new__(cls)
+        count = snap["count"]
+        t.leaves = [None] * count           # finalized leaves are pruned
+        t._branch = [bytes(b) for b in snap["branch"]]
+        # The branch entries at the SET bits of count are exactly the
+        # roots of the finalized full subtrees of the first `count`
+        # leaves: record them for the node resolver.
+        t._final = {}
+        for h in range(DEPOSIT_TREE_DEPTH):
+            if (count >> h) & 1:
+                t._final[(h, (count >> h) - 1)] = t._branch[h]
+        return t
+
+    def _node(self, h: int, idx: int, size: int) -> bytes:
+        """Root of the height-h subtree covering leaves
+        [idx*2^h, (idx+1)*2^h), within a tree of the first `size` leaves.
+        Resolves pruned regions through the finalized-subtree roots;
+        raises if a pruned node is needed that the snapshot cannot
+        reconstruct (only happens for pre-snapshot proofs)."""
+        lo = idx << h
+        if lo >= size:
+            return _ZERO[h]
+        final = getattr(self, "_final", None)
+        if final and lo + (1 << h) <= size and (h, idx) in final:
+            return final[(h, idx)]
+        if h == 0:
+            leaf = self.leaves[lo]
+            if leaf is None:
+                raise DepositCacheError(
+                    "pruned (snapshot-resumed) leaves cannot be proven")
+            return leaf
+        return _sha(self._node(h - 1, 2 * idx, size),
+                    self._node(h - 1, 2 * idx + 1, size))
 
     def proof(self, index: int, deposit_count: Optional[int] = None) -> List[bytes]:
         """Merkle branch for leaf `index` against the subtree of the first
@@ -96,20 +135,16 @@ class DepositTree:
             raise DepositCacheError("count beyond tree")
         if index >= deposit_count:
             raise DepositCacheError("leaf out of range")
-        # Recompute layer by layer (cache-light; proofs are rare next to
-        # pushes — production block assembly asks for <= 16 at a time).
-        layer = list(self.leaves[:deposit_count])
+        if self.leaves[index] is None:
+            raise DepositCacheError(
+                "pruned (snapshot-resumed) leaves cannot be proven")
+        # Sibling nodes via the resolver: works on full trees AND
+        # snapshot-resumed trees proving post-snapshot deposits (pruned
+        # sibling regions resolve through the finalized subtree roots).
         branch = []
         idx = index
         for h in range(DEPOSIT_TREE_DEPTH):
-            sibling = idx ^ 1
-            branch.append(layer[sibling] if sibling < len(layer) else _ZERO[h])
-            nxt = []
-            for i in range(0, len(layer), 2):
-                a = layer[i]
-                b = layer[i + 1] if i + 1 < len(layer) else _ZERO[h]
-                nxt.append(_sha(a, b))
-            layer = nxt
+            branch.append(self._node(h, idx ^ 1, deposit_count))
             idx //= 2
         branch.append(deposit_count.to_bytes(32, "little"))
         return branch
@@ -172,3 +207,63 @@ class DepositCache:
             "deposit_count": best.deposit_count,
             "block_hash": best.hash,
         }
+
+
+# --- eth1-data voting (spec get_eth1_vote) ----------------------------------
+
+SECONDS_PER_ETH1_BLOCK = 14
+ETH1_FOLLOW_DISTANCE = 2048
+
+
+def get_eth1_vote(state, types, spec, cache: "DepositCache",
+                  follow_distance: int = ETH1_FOLLOW_DISTANCE):
+    """The consensus-spec get_eth1_vote over the follower's block cache
+    (validator.md): candidate blocks are those whose timestamp sits one to
+    two follow-distances behind the voting-period start; the vote is the
+    most frequent VALID in-period vote, else the latest candidate's data,
+    else the state's current eth1_data. Candidates must not roll the
+    deposit count backwards."""
+    period_slots = (spec.preset.EPOCHS_PER_ETH1_VOTING_PERIOD *
+                    spec.preset.SLOTS_PER_EPOCH)
+    slot = state.slot
+    period_start = (state.genesis_time +
+                    (slot - slot % period_slots) * spec.seconds_per_slot)
+
+    def is_candidate(b: Eth1Block) -> bool:
+        return (b.timestamp + SECONDS_PER_ETH1_BLOCK * follow_distance
+                <= period_start) and                (b.timestamp + SECONDS_PER_ETH1_BLOCK * follow_distance * 2
+                >= period_start)
+
+    candidates = [
+        b for b in cache.blocks
+        if is_candidate(b) and b.deposit_root is not None
+        and (b.deposit_count or 0) >= state.eth1_data.deposit_count
+    ]
+    to_consider = {
+        (bytes(b.deposit_root), int(b.deposit_count), bytes(b.hash))
+        for b in candidates
+    }
+    valid_votes = [
+        v for v in state.eth1_data_votes
+        if (bytes(v.deposit_root), int(v.deposit_count),
+            bytes(v.block_hash)) in to_consider
+    ]
+    if valid_votes:
+        # Most frequent; ties break toward the earliest occurrence.
+        keyed = {}
+        for i, v in enumerate(valid_votes):
+            k = (bytes(v.deposit_root), int(v.deposit_count),
+                 bytes(v.block_hash))
+            cnt, first = keyed.get(k, (0, i))
+            keyed[k] = (cnt + 1, first)
+        best = max(keyed.items(), key=lambda kv: (kv[1][0], -kv[1][1]))[0]
+        return types.Eth1Data(
+            deposit_root=best[0], deposit_count=best[1], block_hash=best[2]
+        )
+    if candidates:
+        b = max(candidates, key=lambda b: b.number)
+        return types.Eth1Data(
+            deposit_root=b.deposit_root, deposit_count=b.deposit_count,
+            block_hash=b.hash,
+        )
+    return state.eth1_data

@@ -22,15 +22,42 @@ class Eth1Service:
         self._lock = threading.Lock()
 
     def update(self) -> int:
-        """One poll cycle; returns how many new deposits were ingested."""
+        """One poll cycle; returns how many new deposits were ingested.
+
+        Deposit entries may be plain DepositData, (DepositData, leaf)
+        tuples (test stubs), or BLOCK-TAGGED (block_number, DepositData)
+        pairs (the JSON-RPC fetcher): tagged deposits are interleaved
+        with the block snapshots so every Eth1Block gets stamped with the
+        deposit count/root AS OF that block — the pairs eth1-data voting
+        consumes (eth1/src/service.rs block cache semantics)."""
         if self.fetch_fn is None:
             return 0
         with self._lock:
             blocks, deposits = self.fetch_fn(self._last_block)
+            tagged = []
             for dep in deposits:
-                self.cache.insert_deposit(*dep) if isinstance(dep, tuple) \
-                    else self.cache.insert_deposit(dep)
-            for blk in blocks:
+                if isinstance(dep, tuple) and len(dep) == 2 and \
+                        isinstance(dep[0], int):
+                    tagged.append(dep)
+                elif isinstance(dep, tuple):
+                    self.cache.insert_deposit(*dep)
+                else:
+                    self.cache.insert_deposit(dep)
+            tagged.sort(key=lambda t: t[0])
+            ti = 0
+            for blk in sorted(blocks, key=lambda b: b.number):
+                while ti < len(tagged) and tagged[ti][0] <= blk.number:
+                    self.cache.insert_deposit(tagged[ti][1])
+                    ti += 1
+                if blk.deposit_count is None:
+                    blk.deposit_count = self.cache.deposit_count()
+                    blk.deposit_root = self.cache.deposit_root()
                 self.cache.insert_eth1_block(blk)
                 self._last_block = max(self._last_block, blk.number)
+            for bn, dep in tagged[ti:]:
+                # Deposits past the last snapshotted block still advance
+                # the frontier — otherwise the next poll re-fetches the
+                # same logs and pushes DUPLICATE leaves into the tree.
+                self.cache.insert_deposit(dep)
+                self._last_block = max(self._last_block, bn)
             return len(deposits)

@@ -213,6 +213,9 @@ class BlsBatchVerifyHandler(Handler):
 class SszStaticHandler(Handler):
     runner, name = "ssz_static", "containers"
 
+    def __init__(self, name: str = "containers"):
+        self.name = name
+
     def run_case(self, case_dir, tracker):
         meta = tracker.read_json(os.path.join(case_dir, "meta.json"))
         ctx = self.context(case_dir, tracker)
@@ -231,7 +234,10 @@ def _resolve_type(types, name: str, fork: str):
         "BeaconBlock": types.BeaconBlock,
         "SignedBeaconBlock": types.SignedBeaconBlock,
         "BeaconBlockBody": types.BeaconBlockBody,
+        "ExecutionPayloadHeader": types.ExecutionPayloadHeader,
     }
+    if name == "ExecutionPayload":
+        return getattr(types, "ExecutionPayload" + fork.capitalize())
     if name in forked:
         return forked[name][fork]
     return getattr(types, name)
@@ -487,6 +493,149 @@ class ForkChoiceHandler(Handler):
                     f"head {got.hex()[:8]} != {step['expect'][:10]}"
 
 
+class RewardsHandler(Handler):
+    """rewards/basic (ef_tests rewards cases): per-flag attestation reward
+    and penalty deltas plus inactivity penalties over a post-epoch state."""
+
+    runner, name = "rewards", "basic"
+
+    def run_case(self, case_dir, tracker):
+        from lighthouse_tpu.state_transition.epoch_processing import (
+            get_flag_index_deltas,
+            get_inactivity_penalty_deltas,
+        )
+
+        meta = tracker.read_json(os.path.join(case_dir, "meta.json"))
+        ctx = self.context(case_dir, tracker)
+        types, spec = _types_and_spec(ctx["config"])
+        cls = types.BeaconState[ctx["fork"]]
+        state = cls.deserialize(
+            tracker.read(os.path.join(case_dir, "pre.ssz")))
+        for flag_index in range(3):
+            rewards, penalties = get_flag_index_deltas(
+                state, spec, flag_index)
+            assert list(rewards) == meta["flag_rewards"][flag_index], \
+                f"flag {flag_index} rewards drifted"
+            assert list(penalties) == meta["flag_penalties"][flag_index], \
+                f"flag {flag_index} penalties drifted"
+        inact = list(get_inactivity_penalty_deltas(
+            state, spec, ctx["fork"]))
+        assert inact == meta["inactivity_penalties"], "inactivity drifted"
+        # a-priori invariants (implementation-independent): slashed
+        # validators earn nothing; penalties are non-negative.
+        for i, v in enumerate(state.validators):
+            if v.slashed:
+                assert all(meta["flag_rewards"][f][i] == 0
+                           for f in range(3))
+
+
+class MerkleProofValidityHandler(Handler):
+    """merkle_proof/single_merkle_proof: a container field's inclusion
+    branch must reproduce and verify against the object root — and fail
+    against a tampered branch (the negative case is structural, not
+    frozen behavior)."""
+
+    runner, name = "merkle_proof", "single_merkle_proof"
+
+    def run_case(self, case_dir, tracker):
+        from lighthouse_tpu.types import ssz as ssz_mod
+
+        meta = tracker.read_json(os.path.join(case_dir, "meta.json"))
+        ctx = self.context(case_dir, tracker)
+        types, _spec = _types_and_spec(ctx["config"])
+        cls = _resolve_type(types, meta["type"], ctx["fork"])
+        obj = cls.deserialize(
+            tracker.read(os.path.join(case_dir, "object.ssz")))
+        index, leaf, branch = ssz_mod.container_field_proof(
+            cls, obj, meta["field"])
+        assert index == meta["index"], "field index drifted"
+        assert "0x" + leaf.hex() == meta["leaf"], "leaf root drifted"
+        assert ["0x" + b.hex() for b in branch] == meta["branch"], \
+            "branch drifted"
+        root = cls.hash_tree_root(obj)
+        assert ssz_mod.verify_field_proof(root, leaf, branch, index)
+        bad = list(branch)
+        bad[0] = bytes(32)
+        if branch[0] != bad[0]:
+            assert not ssz_mod.verify_field_proof(root, leaf, bad, index)
+
+
+class LightClientHandler(Handler):
+    """light_client/updates: bootstrap + finality-update replay through
+    the LightClientStore, including the negative cases (tampered
+    signature/proof must be rejected)."""
+
+    runner, name = "light_client", "updates"
+
+    def run_case(self, case_dir, tracker):
+        from lighthouse_tpu.light_client.light_client import (
+            LightClientBootstrap,
+            LightClientError,
+            LightClientFinalityUpdate,
+            LightClientStore,
+        )
+
+        meta = tracker.read_json(os.path.join(case_dir, "meta.json"))
+        ctx = self.context(case_dir, tracker)
+        types, spec = _types_and_spec(ctx["config"])
+
+        def hx(s):
+            return bytes.fromhex(s[2:])
+
+        header = types.BeaconBlockHeader.deserialize(
+            tracker.read(os.path.join(case_dir, "bootstrap_header.ssz")))
+        committee = types.SyncCommittee.deserialize(
+            tracker.read(os.path.join(case_dir, "sync_committee.ssz")))
+        boot = LightClientBootstrap(
+            header=header,
+            current_sync_committee=committee,
+            proof_index=meta["bootstrap_proof_index"],
+            proof_branch=[hx(b) for b in meta["bootstrap_branch"]],
+        )
+        store = LightClientStore(
+            types, spec,
+            trusted_block_root=hx(meta["trusted_block_root"]),
+            genesis_validators_root=hx(meta["genesis_validators_root"]),
+            fork_version=hx(meta["fork_version"]),
+            fork=ctx["fork"],
+        )
+        store.process_bootstrap(boot)
+
+        attested = types.BeaconBlockHeader.deserialize(
+            tracker.read(os.path.join(case_dir, "attested_header.ssz")))
+        finalized = types.BeaconBlockHeader.deserialize(
+            tracker.read(os.path.join(case_dir, "finalized_header.ssz")))
+        agg = types.SyncAggregate.deserialize(
+            tracker.read(os.path.join(case_dir, "sync_aggregate.ssz")))
+        upd = LightClientFinalityUpdate(
+            attested_header=attested,
+            finalized_header=finalized,
+            finalized_epoch=meta["finalized_epoch"],
+            finality_proof_index=meta["finality_proof_index"],
+            finality_branch=[hx(b) for b in meta["finality_branch"]],
+            sync_aggregate=agg,
+            signature_slot=meta["signature_slot"],
+        )
+        store.process_finality_update(upd)
+        assert store.finalized_header.slot == finalized.slot
+        # negative: a tampered finality branch must be rejected
+        bad = LightClientFinalityUpdate(
+            attested_header=attested,
+            finalized_header=finalized,
+            finalized_epoch=meta["finalized_epoch"],
+            finality_proof_index=meta["finality_proof_index"],
+            finality_branch=[bytes(32)] * len(meta["finality_branch"]),
+            sync_aggregate=agg,
+            signature_slot=meta["signature_slot"],
+        )
+        try:
+            store.process_finality_update(bad)
+        except LightClientError:
+            pass
+        else:
+            raise AssertionError("tampered finality branch accepted")
+
+
 ALL_HANDLERS: List[Handler] = []
 
 
@@ -497,6 +646,7 @@ def default_handlers() -> List[Handler]:
         BlsFastAggregateVerifyHandler(),
         BlsBatchVerifyHandler(),
         SszStaticHandler(),
+        SszStaticHandler("defaults"),
         ShufflingHandler(),
         SanitySlotsHandler(),
         SanityBlocksHandler(),
@@ -510,6 +660,9 @@ def default_handlers() -> List[Handler]:
         EpochProcessingHandler(),
         TransitionHandler(),
         ForkChoiceHandler(),
+        RewardsHandler(),
+        MerkleProofValidityHandler(),
+        LightClientHandler(),
     ]
 
 

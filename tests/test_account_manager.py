@@ -1,0 +1,145 @@
+"""Wallet CRUD + bulk validator/deposit creation
+(account_manager/src/{wallet,validator}, validator_manager
+create_validators — VERDICT r2 missing #6)."""
+
+import json
+import os
+
+import pytest
+
+from lighthouse_tpu.crypto import keystore as ks
+from lighthouse_tpu.crypto.bls import api as bls
+from lighthouse_tpu.types.containers import make_types
+from lighthouse_tpu.types.spec import (
+    DOMAIN_DEPOSIT,
+    compute_domain,
+    compute_signing_root,
+    minimal_spec,
+)
+from lighthouse_tpu.validator_client.account_manager import (
+    AccountManagerError,
+    WalletManager,
+    create_validators_with_deposits,
+    mnemonic_to_seed,
+)
+
+
+@pytest.fixture
+def mgr(tmp_path):
+    return WalletManager(str(tmp_path / "wallets"))
+
+
+def test_wallet_crud_cycle(mgr):
+    phrase = mgr.create("w1", "pass1")
+    assert len(bytes.fromhex(phrase)) == 32
+    assert [w["name"] for w in mgr.list()] == ["w1"]
+    # create collision refused
+    with pytest.raises(AccountManagerError):
+        mgr.create("w1", "other")
+    # rename + delete
+    mgr.create("w2", "pass2")
+    mgr.rename("w2", "w3")
+    assert sorted(w["name"] for w in mgr.list()) == ["w1", "w3"]
+    with pytest.raises(AccountManagerError):
+        mgr.rename("w1", "w3")
+    mgr.delete("w3")
+    assert [w["name"] for w in mgr.list()] == ["w1"]
+    with pytest.raises(AccountManagerError):
+        mgr.delete("nope")
+
+
+def test_wallet_recover_reproduces_keys(mgr):
+    phrase = mgr.create("a", "pw")
+    w = mgr.open("a", "pw")
+    _, sk0 = w.derive_validator_key(0)
+    # recover under a DIFFERENT password: same derived keys
+    mgr.recover("b", "other-pw", phrase)
+    w2 = mgr.open("b", "other-pw")
+    _, sk0b = w2.derive_validator_key(0)
+    assert sk0.to_bytes() == sk0b.to_bytes()
+    # wrong password fails to open
+    with pytest.raises(Exception):
+        mgr.open("a", "wrong")
+
+
+def test_mnemonic_seed_is_bip39_compatible():
+    # BIP-39 trezor vector (entropy 00..00, TREZOR passphrase):
+    # mnemonic "abandon ... about" -> seed c55257c3...
+    m = ("abandon abandon abandon abandon abandon abandon abandon abandon "
+         "abandon abandon abandon about")
+    seed = mnemonic_to_seed(m, "TREZOR")
+    assert seed.hex().startswith("c55257c360c07c72029aebc1b53c05ed")
+
+
+def test_nextaccount_persists(mgr):
+    mgr.create("w", "pw")
+    w = mgr.open("w", "pw")
+    w.derive_validator_key()
+    w.derive_validator_key()
+    mgr.set_nextaccount("w", w.next_index)
+    again = mgr.open("w", "pw")
+    assert again.next_index == 2
+
+
+def test_bulk_create_with_deposit_data(mgr, tmp_path):
+    spec = minimal_spec()
+    types = make_types(spec.preset)
+    mgr.create("bulk", "pw", entropy=b"\x42" * 32)
+    w = mgr.open("bulk", "pw")
+    vdir = str(tmp_path / "validators")
+    entries = create_validators_with_deposits(
+        w, 3, "kpass", vdir, spec, types
+    )
+    assert len(entries) == 3
+    for e in entries:
+        pk = bytes.fromhex(e["pubkey"])
+        wc = bytes.fromhex(e["withdrawal_credentials"])
+        assert wc[0] == 0  # BLS withdrawal credentials
+        # keystore on disk decrypts back to the signing key of pubkey
+        kpath = os.path.join(vdir, "0x" + e["pubkey"],
+                             "voting-keystore.json")
+        with open(kpath) as f:
+            keystore = json.load(f)
+        sk = bls.SecretKey.from_bytes(ks.decrypt_keystore(keystore, "kpass"))
+        assert sk.public_key().to_bytes() == pk
+        # deposit signature verifies over the DepositMessage signing root
+        msg = types.DepositMessage(
+            pubkey=pk, withdrawal_credentials=wc, amount=e["amount"]
+        )
+        domain = compute_domain(
+            DOMAIN_DEPOSIT, spec.genesis_fork_version, b"\x00" * 32
+        )
+        root = compute_signing_root(msg, types.DepositMessage, domain)
+        assert bls.verify(
+            bls.PublicKey.from_bytes(pk), root,
+            bls.Signature.from_bytes(bytes.fromhex(e["signature"])),
+        )
+        assert types.DepositData.hash_tree_root(types.DepositData(
+            pubkey=pk, withdrawal_credentials=wc, amount=e["amount"],
+            signature=bytes.fromhex(e["signature"]),
+        )).hex() == e["deposit_data_root"]
+    # eth1-credential variant
+    entries2 = create_validators_with_deposits(
+        w, 1, "kpass", vdir, spec, types,
+        eth1_withdrawal_address=b"\xaa" * 20,
+    )
+    wc = bytes.fromhex(entries2[0]["withdrawal_credentials"])
+    assert wc[0] == 1 and wc[12:] == b"\xaa" * 20
+
+
+def test_bulk_create_persists_account_index(mgr, tmp_path):
+    from lighthouse_tpu.types.containers import make_types
+    from lighthouse_tpu.types.spec import minimal_spec
+
+    spec = minimal_spec()
+    types = make_types(spec.preset)
+    mgr.create("persist", "pw", entropy=b"\x07" * 32)
+    vdir = str(tmp_path / "v")
+    first = mgr.bulk_create("persist", "pw", "kp", 2, vdir, spec, types)
+    second = mgr.bulk_create("persist", "pw", "kp", 2, vdir, spec, types)
+    # a re-opened wallet continues PAST the created keys — no duplicate
+    # derivations across restarts (slashing hazard otherwise)
+    pks = {e["pubkey"] for e in first} | {e["pubkey"] for e in second}
+    assert len(pks) == 4
+    assert next(w for w in mgr.list()
+                if w["name"] == "persist")["nextaccount"] == 4
