@@ -1,0 +1,404 @@
+"""Chain analytics: block rewards, packing efficiency, attestation performance.
+
+The compute layer behind the `/lighthouse/analysis/*` HTTP routes — the
+endpoints the reference's watch daemon polls to fill its historical
+database (reference: beacon_node/http_api/src/block_rewards.rs,
+block_packing_efficiency.rs, attestation_performance.rs; consumed by
+watch/src/{block_rewards,block_packing,suboptimal_attestations}).
+
+All three analyses replay the *canonical* chain from stored post-states:
+every imported block's post-state is persisted under its `state_root`
+(store/hot_cold.py), so a block's pre-state is its parent's post-state
+advanced with `process_slots` — the same BlockReplayer recipe the
+reference uses (state_processing::BlockReplayer), with signature
+verification off (the chain verified on import).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from lighthouse_tpu.state_transition import block_processing as bp
+from lighthouse_tpu.state_transition import helpers as h
+from lighthouse_tpu.state_transition import slot_processing as sp
+from lighthouse_tpu.state_transition.block_processing import VerifySignatures
+from lighthouse_tpu.types.spec import (
+    ForkName,
+    TIMELY_HEAD_FLAG_INDEX,
+    TIMELY_SOURCE_FLAG_INDEX,
+    TIMELY_TARGET_FLAG_INDEX,
+)
+
+
+class AnalysisError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Canonical-segment walk
+# ---------------------------------------------------------------------------
+
+
+def canonical_blocks(chain, start_slot: int, end_slot: int) -> List[tuple]:
+    """[(block_root, signed_block)] for canonical blocks with
+    start_slot <= slot <= end_slot, ascending. Walks parent links from the
+    head (the store indexes by root, not slot — same reason the reference
+    walks `rev_iter_block_roots`)."""
+    out = []
+    root = chain.head.block_root
+    block = chain.store.get_block(root)
+    while block is not None:
+        slot = int(block.message.slot)
+        if slot < start_slot:
+            break
+        if slot <= end_slot:
+            out.append((root, block))
+        if slot == 0:
+            break
+        parent = bytes(block.message.parent_root)
+        nxt = chain.store.get_block(parent)
+        root, block = parent, nxt
+    out.reverse()
+    return out
+
+
+def _pre_state(chain, block) -> object:
+    """The block's pre-state: parent post-state advanced to block.slot."""
+    parent_root = bytes(block.message.parent_root)
+    parent = chain.store.get_block(parent_root)
+    if parent is not None:
+        state_root = bytes(parent.message.state_root)
+    else:
+        # Parent is the anchor "block" (a header, not a stored signed
+        # block): the chain records its state root at construction.
+        state_root = chain._state_root_by_block.get(parent_root)
+        if state_root is None:
+            raise AnalysisError("pre-state unavailable (beyond anchor)")
+    state = chain.store.get_state(state_root)
+    if state is None:
+        raise AnalysisError("parent post-state pruned")
+    return sp.process_slots(state, chain.types, chain.spec,
+                            int(block.message.slot))
+
+
+def _state_at_slot(chain, slot: int) -> object:
+    """Canonical state at `slot` (post-block if a block sits there)."""
+    seg = canonical_blocks(chain, 0, slot)
+    if not seg:
+        raise AnalysisError("no canonical block at or before slot")
+    _root, block = seg[-1]
+    state = chain.store.get_state(bytes(block.message.state_root))
+    if state is None:
+        raise AnalysisError("state pruned")
+    if int(state.slot) < slot:
+        state = sp.process_slots(state, chain.types, chain.spec, slot)
+    return state
+
+
+# ---------------------------------------------------------------------------
+# Block rewards (block_rewards.rs: get_block_rewards/compute_block_rewards)
+# ---------------------------------------------------------------------------
+
+
+def _sync_proposer_reward_per_bit(state, spec) -> int:
+    """Per-set-bit proposer reward, the formula process_sync_aggregate
+    applies (block_processing.py:604-616)."""
+    from lighthouse_tpu.types.spec import (
+        PROPOSER_WEIGHT,
+        SYNC_REWARD_WEIGHT,
+        WEIGHT_DENOMINATOR,
+    )
+
+    total_active_increments = (
+        h.get_total_active_balance(state, spec)
+        // spec.effective_balance_increment
+    )
+    total_base_rewards = (
+        bp.get_base_reward_per_increment(state, spec) * total_active_increments
+    )
+    max_participant_rewards = (
+        total_base_rewards * SYNC_REWARD_WEIGHT // WEIGHT_DENOMINATOR
+        // spec.preset.SLOTS_PER_EPOCH
+    )
+    participant_reward = (
+        max_participant_rewards // spec.preset.SYNC_COMMITTEE_SIZE
+    )
+    return (participant_reward * PROPOSER_WEIGHT
+            // (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT))
+
+
+def compute_block_rewards(chain, start_slot: int, end_slot: int) -> List[dict]:
+    """Per-canonical-block proposer reward decomposition.
+
+    Replays each block on its pre-state with the exact per_block_processing
+    call sequence, snapshotting the proposer's balance between phases —
+    bit-identical attribution, no separate reward formulas to drift
+    (reference instead instruments per-component "reward tracking" inside
+    block processing; same numbers, different plumbing)."""
+    if start_slot == 0:
+        raise AnalysisError("start_slot must be > 0")
+    t, spec = chain.types, chain.spec
+    out = []
+    seg = canonical_blocks(chain, start_slot, end_slot)
+    if not seg:
+        return out
+    # One rolling state: the phased application below fully applies each
+    # block, so the next block's pre-state is just process_slots away
+    # (avoids a store load + boundary replay per block).
+    state = _pre_state(chain, seg[0][1])
+    for root, signed in seg:
+        block = signed.message
+        fork = chain.fork_at(int(block.slot))
+        if int(state.slot) < int(block.slot):
+            state = sp.process_slots(state, t, spec, int(block.slot))
+        proposer = int(block.proposer_index)
+        parent = chain.store.get_block(bytes(block.parent_root))
+        parent_slot = int(parent.message.slot) if parent is not None else \
+            int(state.latest_block_header.slot)
+
+        def bal() -> int:
+            return int(state.balances[proposer])
+
+        bp.process_block_header(state, t, spec, block)
+        if ForkName.ge(fork, ForkName.BELLATRIX):
+            bp.process_withdrawals(state, t, spec,
+                                   block.body.execution_payload, fork)
+            bp.process_execution_payload(state, t, spec, block.body, fork)
+        bp.process_randao(state, t, spec, block, fork,
+                          VerifySignatures.FALSE, None)
+        bp.process_eth1_data(state, t, spec, block.body)
+
+        b0 = bal()
+        for ps in block.body.proposer_slashings:
+            bp.process_proposer_slashing(state, t, spec, ps, fork,
+                                         VerifySignatures.FALSE, None)
+        b1 = bal()
+        for asl in block.body.attester_slashings:
+            bp.process_attester_slashing(state, t, spec, asl, fork,
+                                         VerifySignatures.FALSE, None)
+        b2 = bal()
+        for att in block.body.attestations:
+            bp.process_attestation(state, t, spec, att, fork,
+                                   VerifySignatures.FALSE, None)
+        b3 = bal()
+        sync_reward = 0
+        if ForkName.ge(fork, ForkName.ALTAIR):
+            # Analytic, not a balance diff: when the proposer is itself a
+            # sync-committee member its participation reward/penalty would
+            # pollute the diff — the reference's
+            # compute_beacon_block_sync_aggregate_reward counts only the
+            # per-bit proposer inclusion reward (standard_block_rewards.rs).
+            n_bits = sum(
+                1 for b in block.body.sync_aggregate.sync_committee_bits if b
+            )
+            sync_reward = n_bits * _sync_proposer_reward_per_bit(state, spec)
+            bp.process_sync_aggregate(state, t, spec,
+                                      block.body.sync_aggregate,
+                                      VerifySignatures.FALSE, None)
+
+        att_reward = b3 - b2
+        out.append({
+            "block_root": "0x" + root.hex(),
+            "meta": {
+                "slot": str(int(block.slot)),
+                "parent_slot": str(parent_slot),
+                "proposer_index": int(proposer),
+                "graffiti": bytes(block.body.graffiti).decode(
+                    "utf-8", "replace").rstrip("\x00"),
+            },
+            "total": att_reward + sync_reward + (b1 - b0) + (b2 - b1),
+            "attestation_rewards": {"total": att_reward},
+            "sync_committee_rewards": sync_reward,
+            "proposer_slashing_inclusion": b1 - b0,
+            "attester_slashing_inclusion": b2 - b1,
+        })
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Block packing efficiency (block_packing_efficiency.rs)
+# ---------------------------------------------------------------------------
+
+
+def compute_block_packing(chain, start_epoch: int, end_epoch: int) -> List[dict]:
+    """Per-block packing: how many of the attestable (slot, committee,
+    position) tuples in the inclusion window the proposer actually packed.
+
+    Mirrors PackingEfficiencyHandler: a rolling replay state supplies
+    committees as the slot frontier advances; `available` counts tuples in
+    the SLOTS_PER_EPOCH inclusion window not yet included by prior blocks,
+    `included` the new unique tuples this block adds, `prior_skip_slots`
+    the empty slots since the parent."""
+    if start_epoch == 0:
+        raise AnalysisError("start_epoch must be > 0")
+    t, spec = chain.types, chain.spec
+    spe = spec.preset.SLOTS_PER_EPOCH
+    # Warm-up from the prior epoch so the first block's window is populated.
+    walk_start = (start_epoch - 1) * spe
+    start_slot = start_epoch * spe
+    end_slot = (end_epoch + 1) * spe - 1
+    seg = canonical_blocks(chain, max(walk_start, 1), end_slot)
+    if not seg:
+        return []
+
+    state = _pre_state(chain, seg[0][1])
+    committee_sizes: Dict[tuple, int] = {}   # (slot, cidx) -> size
+    included: set = set()                    # (slot, cidx, position)
+    out = []
+    # Pre-populate the window behind the first block (its pre-state can
+    # compute previous-epoch committees; older epochs are skipped).
+    frontier = max(0, int(state.slot) - spe - 1)
+
+    for _root, signed in seg:
+        block = signed.message
+        slot = int(block.slot)
+        fork = chain.fork_at(slot)
+        if int(state.slot) < slot:
+            state = sp.process_slots(state, t, spec, slot)
+        # Committees for newly-reachable slots (<= current epoch of state).
+        for s in range(frontier + 1, slot + 1):
+            epoch_s = spec.epoch_at_slot(s)
+            try:
+                n_comm = h.get_committee_count_per_slot(state, spec, epoch_s)
+            except Exception:
+                continue
+            for ci in range(n_comm):
+                committee_sizes[(s, ci)] = len(
+                    h.get_beacon_committee(state, spec, s, ci)
+                )
+        frontier = slot
+        # Prune the inclusion window (keep the current slot's committees —
+        # they become attestable for the NEXT block).
+        lo = slot - spe
+        committee_sizes = {k: v for k, v in committee_sizes.items()
+                           if k[0] > lo}
+        included = {k for k in included if k[0] > lo}
+
+        available = sum(
+            v for k, v in committee_sizes.items() if k[0] < slot
+        ) - sum(
+            1 for k in included
+            if k[0] < slot and (k[0], k[1]) in committee_sizes
+        )
+        new_included = 0
+        for att in block.body.attestations:
+            a_slot = int(att.data.slot)
+            a_idx = int(att.data.index)
+            for pos, bit in enumerate(att.aggregation_bits):
+                if not bit:
+                    continue
+                key = (a_slot, a_idx, pos)
+                if key not in included:
+                    included.add(key)
+                    new_included += 1
+
+        parent = chain.store.get_block(bytes(block.parent_root))
+        parent_slot = int(parent.message.slot) if parent is not None else \
+            slot - 1
+        if slot >= start_slot:
+            out.append({
+                "slot": str(slot),
+                "block_hash": "0x" + bytes(block.state_root).hex(),
+                "proposer_info": {
+                    "validator_index": int(block.proposer_index),
+                },
+                "available_attestations": available,
+                "included_attestations": new_included,
+                "prior_skip_slots": slot - parent_slot - 1,
+            })
+        bp.per_block_processing(state, t, spec, signed, fork,
+                                VerifySignatures.FALSE,
+                                verify_block_signature=False)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Attestation performance (attestation_performance.rs)
+# ---------------------------------------------------------------------------
+
+
+def compute_attestation_performance(
+    chain, start_epoch: int, end_epoch: int,
+    target_index: Optional[int] = None,
+) -> List[dict]:
+    """Per-validator, per-epoch attestation performance.
+
+    Source/target/head correctness comes from the participation flags the
+    state itself accumulated: epoch e's flags live in
+    `previous_epoch_participation` until the end of epoch e+1 (the
+    reference extracts the same bits via EpochProcessingSummary).
+    Inclusion delay is recovered from the canonical blocks: the first
+    block that includes each (slot, committee, position) tuple sets that
+    validator's delay for the attestation's epoch."""
+    t, spec = chain.types, chain.spec
+    spe = spec.preset.SLOTS_PER_EPOCH
+
+    # --- inclusion delays from the block walk ------------------------------
+    delays: Dict[int, Dict[int, int]] = {}   # epoch -> validator -> delay
+    seen: set = set()
+    seg = canonical_blocks(chain, max(start_epoch * spe, 1),
+                           (end_epoch + 2) * spe - 1)
+    state = _pre_state(chain, seg[0][1]) if seg else None
+    for _root, signed in seg:
+        block = signed.message
+        slot = int(block.slot)
+        fork = chain.fork_at(slot)
+        if int(state.slot) < slot:
+            state = sp.process_slots(state, t, spec, slot)
+        for att in block.body.attestations:
+            a_slot = int(att.data.slot)
+            a_epoch = spec.epoch_at_slot(a_slot)
+            if not (start_epoch <= a_epoch <= end_epoch):
+                continue
+            try:
+                committee = h.get_beacon_committee(
+                    state, spec, a_slot, int(att.data.index)
+                )
+            except Exception:
+                continue
+            for pos, bit in enumerate(att.aggregation_bits):
+                if not bit or pos >= len(committee):
+                    continue
+                key = (a_slot, int(att.data.index), pos)
+                if key in seen:
+                    continue
+                seen.add(key)
+                vi = committee[pos]
+                if target_index is not None and vi != target_index:
+                    continue
+                delays.setdefault(a_epoch, {})[vi] = slot - a_slot
+        bp.per_block_processing(state, t, spec, signed, fork,
+                                VerifySignatures.FALSE,
+                                verify_block_signature=False)
+
+    # --- participation flags per epoch -------------------------------------
+    perf: Dict[int, Dict[int, dict]] = {}    # validator -> epoch -> record
+    for epoch in range(start_epoch, end_epoch + 1):
+        flag_slot = (epoch + 2) * spe - 1    # last slot epoch e is previous
+        try:
+            st = _state_at_slot(chain, flag_slot)
+        except AnalysisError:
+            continue
+        part = st.previous_epoch_participation
+        n = len(st.validators)
+        indices = [target_index] if target_index is not None else range(n)
+        for vi in indices:
+            if vi is None or vi >= n:
+                continue
+            v = st.validators[vi]
+            active = h.is_active_validator(v, epoch)
+            flags = int(part[vi]) if vi < len(part) else 0
+            rec = {
+                "active": bool(active),
+                "source": bool(flags & (1 << TIMELY_SOURCE_FLAG_INDEX)),
+                "target": bool(flags & (1 << TIMELY_TARGET_FLAG_INDEX)),
+                "head": bool(flags & (1 << TIMELY_HEAD_FLAG_INDEX)),
+                "delay": delays.get(epoch, {}).get(vi),
+            }
+            perf.setdefault(vi, {})[epoch] = rec
+
+    return [
+        {"index": vi,
+         "epochs": {str(e): rec for e, rec in sorted(by_epoch.items())}}
+        for vi, by_epoch in sorted(perf.items())
+    ]

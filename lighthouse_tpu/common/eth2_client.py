@@ -181,3 +181,28 @@ class BeaconNodeHttpClient:
         self, contribs_json: List[Dict[str, Any]]
     ) -> None:
         self._post("/eth/v1/validator/contribution_and_proofs", contribs_json)
+
+    # ---- lighthouse analysis endpoints (eth2::lighthouse client methods;
+    # the watch daemon's backfill sources) --------------------------------
+
+    def get_lighthouse_analysis_block_rewards(
+        self, start_slot: int, end_slot: int
+    ) -> List[Dict[str, Any]]:
+        return self._get("/lighthouse/analysis/block_rewards", {
+            "start_slot": str(start_slot), "end_slot": str(end_slot),
+        })
+
+    def get_lighthouse_analysis_block_packing(
+        self, start_epoch: int, end_epoch: int
+    ) -> List[Dict[str, Any]]:
+        return self._get("/lighthouse/analysis/block_packing", {
+            "start_epoch": str(start_epoch), "end_epoch": str(end_epoch),
+        })
+
+    def get_lighthouse_analysis_attestation_performance(
+        self, start_epoch: int, end_epoch: int, target: str = "global"
+    ) -> List[Dict[str, Any]]:
+        return self._get(
+            f"/lighthouse/analysis/attestation_performance/{target}", {
+                "start_epoch": str(start_epoch), "end_epoch": str(end_epoch),
+            })

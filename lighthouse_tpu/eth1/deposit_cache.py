@@ -192,23 +192,6 @@ class DepositCache:
     def insert_eth1_block(self, block: Eth1Block) -> None:
         self.blocks.append(block)
 
-    def eth1_data_for_voting(self, lookahead_timestamp: int):
-        """Pick the latest eth1 block older than the follow distance —
-        the eth1-data voting input (eth1/src/service.rs semantics)."""
-        candidates = [
-            b for b in self.blocks
-            if b.timestamp <= lookahead_timestamp and b.deposit_root
-        ]
-        if not candidates:
-            return None
-        best = max(candidates, key=lambda b: b.number)
-        return {
-            "deposit_root": best.deposit_root,
-            "deposit_count": best.deposit_count,
-            "block_hash": best.hash,
-        }
-
-
 # --- eth1-data voting (spec get_eth1_vote) ----------------------------------
 
 SECONDS_PER_ETH1_BLOCK = 14

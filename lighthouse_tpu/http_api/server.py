@@ -442,6 +442,41 @@ class BeaconApiServer:
                 method == "POST":
             return self._submit_contributions(body)
 
+        # Lighthouse-specific analysis routes (http_api/src/block_rewards.rs,
+        # block_packing_efficiency.rs, attestation_performance.rs) — the
+        # query surface the watch daemon backfills from.
+        if path == "/lighthouse/analysis/block_rewards":
+            from lighthouse_tpu.beacon_chain import analysis
+
+            try:
+                return analysis.compute_block_rewards(
+                    chain, int(query["start_slot"][0]),
+                    int(query["end_slot"][0]))
+            except (analysis.AnalysisError, KeyError, ValueError) as e:
+                raise ApiError(400, repr(e))
+        if path == "/lighthouse/analysis/block_packing":
+            from lighthouse_tpu.beacon_chain import analysis
+
+            try:
+                return analysis.compute_block_packing(
+                    chain, int(query["start_epoch"][0]),
+                    int(query["end_epoch"][0]))
+            except (analysis.AnalysisError, KeyError, ValueError) as e:
+                raise ApiError(400, repr(e))
+        m = re.fullmatch(
+            r"/lighthouse/analysis/attestation_performance/(global|\d+)",
+            path)
+        if m:
+            from lighthouse_tpu.beacon_chain import analysis
+
+            target = None if m.group(1) == "global" else int(m.group(1))
+            try:
+                return analysis.compute_attestation_performance(
+                    chain, int(query["start_epoch"][0]),
+                    int(query["end_epoch"][0]), target_index=target)
+            except (analysis.AnalysisError, KeyError, ValueError) as e:
+                raise ApiError(400, repr(e))
+
         raise ApiError(404, f"unknown route {method} {path}")
 
     def _submit_sync_messages(self, body) -> Dict[str, Any]:
@@ -551,6 +586,33 @@ class BeaconApiServer:
             block = chain.store.get_block(chain.fork_choice.finalized.root)
         elif block_id.startswith("0x"):
             block = chain.store.get_block(bytes.fromhex(block_id[2:]))
+        elif block_id.isdigit():
+            # Canonical block at a slot (beacon-API <slot> block id).
+            # Recent slots resolve O(1) via the head state's block_roots
+            # vector; older ones fall back to the parent-link walk.
+            from lighthouse_tpu.state_transition import helpers as sthelp
+
+            slot = int(block_id)
+            head = chain.head
+            block = None
+            shr = chain.spec.preset.SLOTS_PER_HISTORICAL_ROOT
+            if slot <= head.state.slot < slot + shr:
+                try:
+                    root = sthelp.get_block_root_at_slot(
+                        head.state, chain.spec, slot
+                    ) if slot < head.state.slot else head.block_root
+                    cand = chain.store.get_block(bytes(root))
+                    # block_roots carries the prior root through skip
+                    # slots — only an exact slot match is "the block at".
+                    if cand is not None and int(cand.message.slot) == slot:
+                        block = cand
+                except Exception:
+                    block = None
+            if block is None:
+                from lighthouse_tpu.beacon_chain import analysis
+
+                seg = analysis.canonical_blocks(chain, slot, slot)
+                block = seg[0][1] if seg else None
         else:
             raise ApiError(400, f"unsupported block id {block_id}")
         if block is None:

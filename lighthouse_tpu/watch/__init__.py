@@ -1,6 +1,6 @@
 """Chain-monitoring daemon (reference: watch/ — Postgres there, SQLite
 here; same updater/database/server split)."""
 
-from .watch import WatchDB, WatchUpdater
+from .watch import WatchDB, WatchServer, WatchUpdater
 
-__all__ = ["WatchDB", "WatchUpdater"]
+__all__ = ["WatchDB", "WatchServer", "WatchUpdater"]

@@ -33,6 +33,28 @@ class WatchDB:
             "CREATE TABLE IF NOT EXISTS proposer_history ("
             " slot INTEGER PRIMARY KEY, proposer INTEGER, proposed INTEGER)"
         )
+        # Analytics tables (watch/src/{block_rewards,block_packing,
+        # suboptimal_attestations,blockprint}/database.rs).
+        cur.execute(
+            "CREATE TABLE IF NOT EXISTS block_rewards ("
+            " slot INTEGER PRIMARY KEY, root BLOB, total INTEGER,"
+            " attestation_reward INTEGER, sync_committee_reward INTEGER)"
+        )
+        cur.execute(
+            "CREATE TABLE IF NOT EXISTS block_packing ("
+            " slot INTEGER PRIMARY KEY, root BLOB, available INTEGER,"
+            " included INTEGER, prior_skip_slots INTEGER)"
+        )
+        cur.execute(
+            "CREATE TABLE IF NOT EXISTS suboptimal_attestations ("
+            " epoch_start_slot INTEGER, validator_index INTEGER,"
+            " source INTEGER, head INTEGER, target INTEGER, delay INTEGER,"
+            " PRIMARY KEY (epoch_start_slot, validator_index))"
+        )
+        cur.execute(
+            "CREATE TABLE IF NOT EXISTS blockprint ("
+            " slot INTEGER PRIMARY KEY, proposer INTEGER, best_guess TEXT)"
+        )
         self._conn.commit()
 
     def close(self):
@@ -109,6 +131,163 @@ class WatchDB:
         row = cur.fetchone()[0]
         return row if row is not None else 0
 
+    # --------------------------------------------------- analytics: rewards
+
+    _REWARD_COLS = ("slot", "root", "total", "attestation_reward",
+                    "sync_committee_reward")
+
+    def insert_batch_block_rewards(self, rows: List[dict]) -> None:
+        """rows: /lighthouse/analysis/block_rewards response items."""
+        with self._lock:
+            self._conn.executemany(
+                "INSERT OR REPLACE INTO block_rewards VALUES (?, ?, ?, ?, ?)",
+                [(int(r["meta"]["slot"]),
+                  bytes.fromhex(r["block_root"][2:]),
+                  int(r["total"]),
+                  int(r["attestation_rewards"]["total"]),
+                  int(r["sync_committee_rewards"])) for r in rows],
+            )
+            self._conn.commit()
+
+    def get_block_rewards_by_slot(self, slot: int) -> Optional[dict]:
+        cur = self._conn.execute(
+            "SELECT * FROM block_rewards WHERE slot = ?", (slot,))
+        row = cur.fetchone()
+        return dict(zip(self._REWARD_COLS, row)) if row else None
+
+    def get_block_rewards_by_root(self, root: bytes) -> Optional[dict]:
+        cur = self._conn.execute(
+            "SELECT * FROM block_rewards WHERE root = ?", (root,))
+        row = cur.fetchone()
+        return dict(zip(self._REWARD_COLS, row)) if row else None
+
+    def get_highest_block_rewards(self) -> Optional[dict]:
+        cur = self._conn.execute(
+            "SELECT * FROM block_rewards ORDER BY slot DESC LIMIT 1")
+        row = cur.fetchone()
+        return dict(zip(self._REWARD_COLS, row)) if row else None
+
+    def get_lowest_block_rewards(self) -> Optional[dict]:
+        cur = self._conn.execute(
+            "SELECT * FROM block_rewards ORDER BY slot ASC LIMIT 1")
+        row = cur.fetchone()
+        return dict(zip(self._REWARD_COLS, row)) if row else None
+
+    def get_unknown_block_rewards(self, limit: int = 100) -> List[int]:
+        """Canonical non-skipped slots with no rewards row yet (the
+        backfill frontier; reference get_unknown_block_rewards)."""
+        cur = self._conn.execute(
+            "SELECT c.slot FROM canonical_slots c"
+            " LEFT JOIN block_rewards r ON c.slot = r.slot"
+            " WHERE c.skipped = 0 AND r.slot IS NULL AND c.slot > 0"
+            " ORDER BY c.slot DESC LIMIT ?", (limit,))
+        return [r[0] for r in cur.fetchall()]
+
+    # --------------------------------------------------- analytics: packing
+
+    _PACKING_COLS = ("slot", "root", "available", "included",
+                     "prior_skip_slots")
+
+    def insert_batch_block_packing(self, rows: List[dict]) -> None:
+        """rows: /lighthouse/analysis/block_packing response items."""
+        with self._lock:
+            self._conn.executemany(
+                "INSERT OR REPLACE INTO block_packing VALUES (?, ?, ?, ?, ?)",
+                [(int(r["slot"]),
+                  bytes.fromhex(r["block_hash"][2:]),
+                  int(r["available_attestations"]),
+                  int(r["included_attestations"]),
+                  int(r["prior_skip_slots"])) for r in rows],
+            )
+            self._conn.commit()
+
+    def get_block_packing_by_slot(self, slot: int) -> Optional[dict]:
+        cur = self._conn.execute(
+            "SELECT * FROM block_packing WHERE slot = ?", (slot,))
+        row = cur.fetchone()
+        return dict(zip(self._PACKING_COLS, row)) if row else None
+
+    def get_unknown_block_packing(self, limit: int = 100,
+                                  min_slot: int = 1) -> List[int]:
+        """min_slot: epoch-0 slots are never fillable (packing starts at
+        epoch 1) — callers pass SLOTS_PER_EPOCH so the frontier drains."""
+        cur = self._conn.execute(
+            "SELECT c.slot FROM canonical_slots c"
+            " LEFT JOIN block_packing p ON c.slot = p.slot"
+            " WHERE c.skipped = 0 AND p.slot IS NULL AND c.slot >= ?"
+            " ORDER BY c.slot DESC LIMIT ?", (min_slot, limit))
+        return [r[0] for r in cur.fetchall()]
+
+    def packing_efficiency(self) -> Optional[float]:
+        cur = self._conn.execute(
+            "SELECT SUM(included), SUM(available) FROM block_packing")
+        inc, avail = cur.fetchone()
+        if not avail:
+            return None
+        return inc / avail
+
+    # ------------------------------------- analytics: attestation performance
+
+    def insert_suboptimal_attestations(self, epoch_start_slot: int,
+                                       rows: List[dict]) -> None:
+        """rows: attestation_performance items; only SUBOPTIMAL epochs are
+        stored (missed source/head/target or delay > 1 — the reference
+        stores the full set per epoch but serves "suboptimal" queries;
+        storing only the misses keeps the table a miss-list)."""
+        to_insert = []
+        for r in rows:
+            for ep, rec in r["epochs"].items():
+                if not rec["active"]:
+                    continue
+                sub = (not rec["source"] or not rec["head"]
+                       or not rec["target"]
+                       or (rec["delay"] or 0) > 1)
+                if sub:
+                    to_insert.append(
+                        (epoch_start_slot, int(r["index"]),
+                         int(rec["source"]), int(rec["head"]),
+                         int(rec["target"]), rec["delay"]))
+        with self._lock:
+            self._conn.executemany(
+                "INSERT OR REPLACE INTO suboptimal_attestations"
+                " VALUES (?, ?, ?, ?, ?, ?)", to_insert)
+            self._conn.commit()
+
+    def get_suboptimal_validators(self, epoch_start_slot: int) -> List[dict]:
+        cur = self._conn.execute(
+            "SELECT validator_index, source, head, target, delay"
+            " FROM suboptimal_attestations WHERE epoch_start_slot = ?",
+            (epoch_start_slot,))
+        return [dict(zip(("index", "source", "head", "target", "delay"), r))
+                for r in cur.fetchall()]
+
+    # ----------------------------------------------- analytics: blockprint
+
+    def insert_blockprint(self, slot: int, proposer: int,
+                          best_guess: str) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO blockprint VALUES (?, ?, ?)",
+                (slot, proposer, best_guess))
+            self._conn.commit()
+
+    def get_blockprint_by_slot(self, slot: int) -> Optional[str]:
+        cur = self._conn.execute(
+            "SELECT best_guess FROM blockprint WHERE slot = ?", (slot,))
+        row = cur.fetchone()
+        return row[0] if row else None
+
+    def get_blockprint_percentages(self) -> Dict[str, float]:
+        """Client-distribution estimate over fingerprinted blocks
+        (reference blockprint/server.rs percentages route)."""
+        cur = self._conn.execute(
+            "SELECT best_guess, COUNT(*) FROM blockprint GROUP BY best_guess")
+        counts = dict(cur.fetchall())
+        total = sum(counts.values())
+        if not total:
+            return {}
+        return {k: v / total for k, v in counts.items()}
+
 
 class WatchUpdater:
     """Polls a beacon node and fills the DB (watch/src/updater)."""
@@ -183,3 +362,168 @@ class WatchUpdater:
         block = from_json(self.types.BeaconBlock[fork],
                           block_json["data"]["message"])
         return self.types.BeaconBlock[fork].hash_tree_root(block)
+
+    # ---------------------------------------------------- analytics backfill
+
+    def backfill_block_rewards(self, limit: int = 100) -> int:
+        """Fill reward rows for known canonical slots via
+        /lighthouse/analysis/block_rewards (watch/src/block_rewards/mod.rs
+        get_block_rewards + updater loop, collapsed to one poll)."""
+        unknown = self.db.get_unknown_block_rewards(limit)
+        if not unknown:
+            return 0
+        rows = self.client.get_lighthouse_analysis_block_rewards(
+            min(unknown), max(unknown))
+        self.db.insert_batch_block_rewards(rows)
+        return len(rows)
+
+    def backfill_block_packing(self, slots_per_epoch: int = 8,
+                               limit: int = 100) -> int:
+        unknown = self.db.get_unknown_block_packing(
+            limit, min_slot=slots_per_epoch)
+        if not unknown:
+            return 0
+        lo = max(1, min(unknown) // slots_per_epoch)
+        hi = max(unknown) // slots_per_epoch
+        rows = self.client.get_lighthouse_analysis_block_packing(lo, hi)
+        self.db.insert_batch_block_packing(rows)
+        return len(rows)
+
+    def backfill_attestation_performance(self, start_epoch: int,
+                                         end_epoch: int,
+                                         slots_per_epoch: int = 8) -> int:
+        rows = self.client.get_lighthouse_analysis_attestation_performance(
+            start_epoch, end_epoch)
+        for epoch in range(start_epoch, end_epoch + 1):
+            self.db.insert_suboptimal_attestations(
+                epoch * slots_per_epoch,
+                [{"index": r["index"],
+                  "epochs": {k: v for k, v in r["epochs"].items()
+                             if int(k) == epoch}} for r in rows])
+        return len(rows)
+
+    def update_blockprint(self, fingerprint=None) -> int:
+        """Fingerprint proposals per slot. The reference defers to an
+        external blockprint ML service (watch/src/blockprint/); offline,
+        the default fingerprint is a graffiti-prefix heuristic with the
+        same database/query surface, and any callable
+        (block_json -> best_guess str) can be plugged in its place."""
+        fingerprint = fingerprint or _graffiti_fingerprint
+        from lighthouse_tpu.common.eth2_client import Eth2ClientError
+
+        n = 0
+        for slot in range(1, self.db.highest_slot() + 1):
+            blk = self.db.block_at_slot(slot)
+            if blk is None or self.db.get_blockprint_by_slot(slot) is not None:
+                continue
+            try:
+                out = self.client.get_block(str(slot))
+            except Eth2ClientError:
+                continue
+            self.db.insert_blockprint(
+                slot, blk["proposer"], fingerprint(out))
+            n += 1
+        return n
+
+
+_CLIENT_GRAFFITI = (
+    ("lighthouse", "Lighthouse"), ("prysm", "Prysm"), ("teku", "Teku"),
+    ("nimbus", "Nimbus"), ("lodestar", "Lodestar"), ("grandine", "Grandine"),
+)
+
+
+def _graffiti_fingerprint(block_json: dict) -> str:
+    g = block_json["data"]["message"]["body"].get("graffiti", "0x")
+    try:
+        text = bytes.fromhex(g[2:]).decode("utf-8", "replace").lower()
+    except ValueError:
+        text = ""
+    for needle, name in _CLIENT_GRAFFITI:
+        if needle in text:
+            return name
+    return "Unknown"
+
+
+class WatchServer:
+    """HTTP query surface over WatchDB (watch/src/server/): block, rewards,
+    packing, suboptimal-attester and client-distribution lookups."""
+
+    def __init__(self, db: WatchDB, port: int = 0):
+        import json as _json
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                try:
+                    body = outer._route(self.path)
+                    status = 200 if body is not None else 404
+                    data = _json.dumps(
+                        body if body is not None else {"error": "not found"}
+                    ).encode()
+                except Exception as e:
+                    status, data = 500, _json.dumps(
+                        {"error": repr(e)}).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.db = db
+        self.server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self.server.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True)
+
+    def start(self) -> "WatchServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+
+    def _route(self, path: str):
+        import re
+
+        db = self.db
+        m = re.fullmatch(r"/v1/blocks/(\d+)", path)
+        if m:
+            blk = db.block_at_slot(int(m.group(1)))
+            if blk is None:
+                return None
+            blk["root"] = "0x" + blk["root"].hex() if blk["root"] else None
+            blk["parent_root"] = (
+                "0x" + blk["parent_root"].hex() if blk["parent_root"] else None
+            )
+            return blk
+        m = re.fullmatch(r"/v1/blocks/(\d+)/rewards", path)
+        if m:
+            r = db.get_block_rewards_by_slot(int(m.group(1)))
+            if r is None:
+                return None
+            r["root"] = "0x" + r["root"].hex()
+            return r
+        m = re.fullmatch(r"/v1/blocks/(\d+)/packing", path)
+        if m:
+            r = db.get_block_packing_by_slot(int(m.group(1)))
+            if r is None:
+                return None
+            r["root"] = "0x" + r["root"].hex()
+            return r
+        m = re.fullmatch(r"/v1/validators/suboptimal/(\d+)", path)
+        if m:
+            return db.get_suboptimal_validators(int(m.group(1)))
+        if path == "/v1/clients/percentages":
+            return db.get_blockprint_percentages()
+        if path == "/v1/proposers":
+            return {str(k): v for k, v in db.proposer_counts().items()}
+        if path == "/v1/packing/efficiency":
+            return {"efficiency": db.packing_efficiency()}
+        return None

@@ -113,22 +113,6 @@ def test_deposit_proofs_against_snapshot_count():
         assert node == snapshot_root, f"snapshot proof {idx} failed"
 
 
-def test_eth1_data_voting_pick():
-    cache = DepositCache()
-    cache.insert_eth1_block(Eth1Block(1, b"\x01" * 32, 100,
-                                      deposit_root=b"\xaa" * 32,
-                                      deposit_count=3))
-    cache.insert_eth1_block(Eth1Block(2, b"\x02" * 32, 200,
-                                      deposit_root=b"\xbb" * 32,
-                                      deposit_count=4))
-    cache.insert_eth1_block(Eth1Block(3, b"\x03" * 32, 300,
-                                      deposit_root=b"\xcc" * 32,
-                                      deposit_count=5))
-    vote = cache.eth1_data_for_voting(lookahead_timestamp=250)
-    assert vote["block_hash"] == b"\x02" * 32
-    assert cache.eth1_data_for_voting(50) is None
-
-
 # --- round-3 eth1 depth (VERDICT r2 missing #5) -----------------------------
 
 
