@@ -45,6 +45,13 @@ def cmd_bn(args) -> int:
         jwt_secret=bytes.fromhex(args.jwt_secret) if args.jwt_secret else None,
         real_clock=True,
     )
+    if args.bls_backend == "tpu":
+        # Background-compile the production bucket grid at startup so the
+        # batch former reaches full batches without mid-slot cold compiles
+        # (beacon_processor/warming.py).
+        from lighthouse_tpu.beacon_processor.warming import DEFAULT_SHAPE_GRID
+
+        cfg.warm_device_shapes = DEFAULT_SHAPE_GRID
     client = ClientBuilder(cfg).build()
     client.start()
     print(f"beacon node up: http API on {client.api.url if client.api else 'off'}")
@@ -300,19 +307,23 @@ def cmd_mock_el(args) -> int:
 
 
 def cmd_generate_enr(args) -> int:
-    """lcli ENR tooling: build + print a local ENR record."""
-    from lighthouse_tpu.network.discovery import Enr
+    """lcli ENR tooling: build + print a real EIP-778 record (signed RLP,
+    `enr:` base64url text — interoperable with any discv5 tooling)."""
+    from lighthouse_tpu.network.discovery import make_node_enr
+    from lighthouse_tpu.network.enr import generate_key
 
     bits = 0
     for s in (args.attnets or "").split(","):
         if s:
             bits |= 1 << int(s)
-    enr = Enr(peer_id=args.peer_id, attnets=bits)
+    key = generate_key()
+    enr = make_node_enr(key, args.peer_id, attnets=bits)
     print(json.dumps({
+        "enr": enr.to_text(),
         "peer_id": enr.peer_id,
         "node_id": "0x" + enr.node_id.hex(),
         "seq": enr.seq,
-        "attnets": f"0x{enr.attnets:016x}",
+        "attnets": "0x" + (enr.get(b"attnets") or b"").hex(),
         "subscribed_subnets": [
             i for i in range(64) if enr.subscribed_to_attnet(i)
         ],

@@ -1,40 +1,42 @@
-"""Peer discovery — ENR records + a Kademlia-style lookup over the
+"""Peer discovery — EIP-778 ENRs + a Kademlia-style lookup over the
 transport fabric.
 
 Mirror of lighthouse_network/src/discovery (discv5 0.4.1 there): nodes
-carry signed-equivalent ENR records (sequence number, peer id, subnet
-bitfields — enr.rs ATTESTATION_BITFIELD_ENR_KEY), bootstrap from seed
+carry REAL signed node records on the wire (RLP bytes of
+lighthouse_tpu.network.enr.Enr — secp256k1 v4 scheme, keccak node ids,
+eth2/attnets/syncnets fields per enr.rs:22-26), bootstrap from seed
 nodes (boot_node/), answer FINDNODE queries with their closest known
 records by XOR distance, and filter results through subnet predicates
-(discovery/subnet_predicate.rs). The same frames ride the SimTransport in
-tests and a UDP codec in deployment.
+(discovery/subnet_predicate.rs). Records with bad signatures or stale
+sequence numbers are dropped at the wire, exactly like discv5's table
+admission. The same frames ride the SimTransport in tests and the UDP
+codec in deployment.
 """
 
 from __future__ import annotations
 
-import hashlib
-import random
 import threading
-from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Set
 
+from .enr import Enr, EnrError, bitfield_bytes, generate_key
 
-@dataclass
-class Enr:
-    """Ethereum Node Record (reduced): identity + liveness + capabilities."""
 
-    peer_id: str
-    seq: int = 1
-    attnets: int = 0     # 64-bit attestation-subnet bitfield
-    syncnets: int = 0    # 4-bit sync-committee bitfield
-    fork_digest: bytes = b"\x00" * 4
-
-    @property
-    def node_id(self) -> bytes:
-        return hashlib.sha256(self.peer_id.encode()).digest()
-
-    def subscribed_to_attnet(self, subnet: int) -> bool:
-        return bool((self.attnets >> subnet) & 1)
+def make_node_enr(key, peer_id: str, attnets: int = 0, syncnets: int = 0,
+                  fork_digest: bytes = b"\x00" * 4, seq: int = 1,
+                  ip: Optional[str] = None, tcp: Optional[int] = None,
+                  udp: Optional[int] = None) -> Enr:
+    """A signed eth2 node record (enr.rs build_enr): `eth2` carries the
+    ENRForkID prefix (fork digest; next-fork fields zero until scheduled),
+    attnets/syncnets the SSZ bitvector bytes, and `pid` the in-repo
+    fabric's transport address (stands beside ip/tcp/udp, which real
+    discv5 peers use)."""
+    return Enr.build(
+        key, seq=seq, ip=ip, tcp=tcp, udp=udp,
+        eth2=fork_digest + b"\x00" * 4 + b"\x00" * 8,
+        attnets=bitfield_bytes(attnets, 8),
+        syncnets=bitfield_bytes(syncnets, 1),
+        extra={b"pid": peer_id.encode()},
+    )
 
 
 def subnet_predicate(subnets: List[int]) -> Callable[[Enr], bool]:
@@ -52,32 +54,80 @@ def _distance(a: bytes, b: bytes) -> int:
 
 class Discovery:
     """Per-node discovery service; `transport.send` carries
-    ("disc_findnode", ...) / ("disc_nodes", ...) frames."""
+    ("disc_findnode", seq, enr_rlp) / ("disc_nodes", seq, [enr_rlp, ...])
+    frames — the records on the wire are signed EIP-778 RLP."""
 
     MAX_RESPONSE = 16
 
-    def __init__(self, local_enr: Enr, transport):
+    def __init__(self, local_enr: Enr, transport, key=None):
+        self.key = key          # needed for update_local_enr re-signing
         self.local_enr = local_enr
         self.transport = transport
-        self.records: Dict[str, Enr] = {}
+        # Keyed by node_id (the keccak of the signing key) — NOT any
+        # attacker-chosen field: a record can only supersede one signed by
+        # the SAME key with a lower seq, exactly discv5's table rule.
+        self.records: Dict[bytes, Enr] = {}
         self._lock = threading.Lock()
         self._seq = 0
+
+    @classmethod
+    def create(cls, peer_id: str, transport, key=None, **enr_fields
+               ) -> "Discovery":
+        key = key or generate_key()
+        return cls(make_node_enr(key, peer_id, **enr_fields), transport,
+                   key=key)
 
     # ------------------------------------------------------------- registry
 
     def add_enr(self, enr: Enr) -> None:
-        if enr.peer_id == self.local_enr.peer_id:
+        """Table admission: verified records only, newest seq per NODE ID
+        (a different key claiming the same transport pid gets its own
+        entry — it cannot evict or freeze out the genuine record)."""
+        if enr.node_id == self.local_enr.node_id:
             return  # never table ourselves
         with self._lock:
-            existing = self.records.get(enr.peer_id)
+            existing = self.records.get(enr.node_id)
             if existing is None or enr.seq > existing.seq:
-                self.records[enr.peer_id] = enr
+                self.records[enr.node_id] = enr
 
-    def update_local_enr(self, **changes) -> None:
-        """Bump seq on every mutation (ENR semantics)."""
-        for k, v in changes.items():
-            setattr(self.local_enr, k, v)
-        self.local_enr.seq += 1
+    def record_for_peer(self, peer_id: str) -> Optional[Enr]:
+        """Newest record announcing this transport address (tests and the
+        dialer's convenience lookup; identity remains the node id)."""
+        with self._lock:
+            best = None
+            for rec in self.records.values():
+                if rec.peer_id == peer_id and (
+                        best is None or rec.seq > best.seq):
+                    best = rec
+            return best
+
+    def _admit_wire(self, raw: bytes) -> Optional[Enr]:
+        """Decode + signature-verify a wire record; None (dropped) on any
+        malformation — the discv5 rule that unverifiable records never
+        enter the table."""
+        try:
+            return Enr.from_rlp(raw)
+        except (EnrError, Exception):
+            return None
+
+    def update_local_enr(self, attnets: Optional[int] = None,
+                         syncnets: Optional[int] = None,
+                         fork_digest: Optional[bytes] = None,
+                         **fields) -> None:
+        """Re-sign with seq + 1 on every mutation (ENR semantics; the
+        reference bumps seq through the enr crate the same way)."""
+        if self.key is None:
+            raise EnrError("discovery has no key to re-sign the ENR")
+        extra = {}
+        if attnets is not None:
+            extra[b"attnets"] = bitfield_bytes(attnets, 8)
+        if syncnets is not None:
+            extra[b"syncnets"] = bitfield_bytes(syncnets, 1)
+        if fork_digest is not None:
+            extra[b"eth2"] = fork_digest + b"\x00" * 12
+        self.local_enr = self.local_enr.with_updates(
+            self.key, extra=extra, **fields
+        )
 
     def table_len(self) -> int:
         with self._lock:
@@ -92,7 +142,6 @@ class Discovery:
         query bootstrap + closest known until no closer records arrive."""
         for peer in bootstrap:
             self._query(peer)
-        # Iterate: query the closest unqueried records a few rounds.
         queried: Set[str] = set(bootstrap)
         for _ in range(3):
             with self._lock:
@@ -109,58 +158,54 @@ class Discovery:
                 self._query(peer)
         with self._lock:
             out = [e for e in self.records.values()
-                   if e.peer_id != self.local_enr.peer_id]
+                   if e.node_id != self.local_enr.node_id]
         if predicate is not None:
             out = [e for e in out if predicate(e)]
         out.sort(key=lambda e: _distance(e.node_id, self.local_enr.node_id))
         return out[:want]
 
     def _query(self, peer_id: str) -> None:
-        import dataclasses
-
         self._seq += 1
-        # Copy the ENR: frames model serialization, so a later local mutation
-        # must not reach into remote tables by reference.
         self.transport.send(
             self.local_enr.peer_id, peer_id,
-            ("disc_findnode", self._seq, dataclasses.replace(self.local_enr)),
+            ("disc_findnode", self._seq, self.local_enr.to_rlp()),
         )
 
     # --------------------------------------------------------------- frames
 
     def handle_frame(self, src: str, frame: tuple) -> None:
-        import dataclasses
-
         kind = frame[0]
         if kind == "disc_findnode":
-            _, seq, requester_enr = frame
-            self.add_enr(requester_enr)
+            _, seq, requester_raw = frame
+            requester = self._admit_wire(requester_raw)
+            if requester is None:
+                return
+            self.add_enr(requester)
             with self._lock:
                 closest = sorted(
                     (e for e in self.records.values()
-                     if e.peer_id != requester_enr.peer_id),
-                    key=lambda e: _distance(
-                        e.node_id, requester_enr.node_id
-                    ),
+                     if e.node_id != requester.node_id),
+                    key=lambda e: _distance(e.node_id, requester.node_id),
                 )[: self.MAX_RESPONSE]
             self.transport.send(
                 self.local_enr.peer_id, src,
                 ("disc_nodes", seq,
-                 [dataclasses.replace(e)
-                  for e in [self.local_enr] + closest]),
+                 [e.to_rlp() for e in [self.local_enr] + closest]),
             )
         elif kind == "disc_nodes":
-            _, seq, enrs = frame
-            for enr in enrs:
-                self.add_enr(enr)
+            _, seq, raw_enrs = frame
+            for raw in raw_enrs:
+                rec = self._admit_wire(raw)
+                if rec is not None:
+                    self.add_enr(rec)
 
 
 class BootNode:
     """Standalone record-server (boot_node/): discovery with no chain."""
 
-    def __init__(self, peer_id: str, transport):
+    def __init__(self, peer_id: str, transport, key=None):
         self.peer_id = peer_id
-        self.discovery = Discovery(Enr(peer_id=peer_id), transport)
+        self.discovery = Discovery.create(peer_id, transport, key=key)
         if hasattr(transport, "register"):
             transport.register(self)
 

@@ -1,0 +1,472 @@
+"""EIP-778 Ethereum Node Records — the discv5 identity wire format.
+
+Real-format ENRs (RLP content, secp256k1 "v4" identity scheme, keccak-256
+node ids, `enr:` base64url text form), replacing round-2's in-house
+record dict (reference: beacon_node/lighthouse_network/src/discovery/
+enr.rs and the enr crate it builds on). The eth2-specific fields mirror
+enr.rs:22-26: "eth2" (ENRForkID ssz), "attnets", "syncnets".
+
+Dependencies are all in-image: `cryptography` for secp256k1 ECDSA; RLP
+and keccak-f[1600] are implemented here (no rlp/pysha3 wheels ship in
+this environment — keccak is the pre-NIST-padding variant, which hashlib
+deliberately does not provide).
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Dict, List, Optional, Tuple
+
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    Prehashed,
+    decode_dss_signature,
+    encode_dss_signature,
+)
+
+MAX_ENR_SIZE = 300  # EIP-778: records are at most 300 bytes
+
+
+class EnrError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# keccak-256 (pre-NIST padding 0x01; NIST SHA3 pads 0x06 — different hashes)
+# ---------------------------------------------------------------------------
+
+_ROUND_CONSTANTS = [
+    0x0000000000000001, 0x0000000000008082, 0x800000000000808A,
+    0x8000000080008000, 0x000000000000808B, 0x0000000080000001,
+    0x8000000080008081, 0x8000000000008009, 0x000000000000008A,
+    0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+    0x000000008000808B, 0x800000000000008B, 0x8000000000008089,
+    0x8000000000008003, 0x8000000000008002, 0x8000000000000080,
+    0x000000000000800A, 0x800000008000000A, 0x8000000080008081,
+    0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+]
+_ROTATIONS = [
+    [0, 36, 3, 41, 18], [1, 44, 10, 45, 2], [62, 6, 43, 15, 61],
+    [28, 55, 25, 21, 56], [27, 20, 39, 8, 14],
+]
+_MASK = (1 << 64) - 1
+
+
+def _rol(x: int, n: int) -> int:
+    n %= 64
+    return ((x << n) | (x >> (64 - n))) & _MASK
+
+
+def _keccak_f(state: List[List[int]]) -> None:
+    for rc in _ROUND_CONSTANTS:
+        # theta
+        c = [state[x][0] ^ state[x][1] ^ state[x][2] ^ state[x][3] ^ state[x][4]
+             for x in range(5)]
+        d = [c[(x - 1) % 5] ^ _rol(c[(x + 1) % 5], 1) for x in range(5)]
+        for x in range(5):
+            for y in range(5):
+                state[x][y] ^= d[x]
+        # rho + pi
+        b = [[0] * 5 for _ in range(5)]
+        for x in range(5):
+            for y in range(5):
+                b[y][(2 * x + 3 * y) % 5] = _rol(state[x][y], _ROTATIONS[x][y])
+        # chi
+        for x in range(5):
+            for y in range(5):
+                state[x][y] = b[x][y] ^ ((~b[(x + 1) % 5][y]) & b[(x + 2) % 5][y])
+        # iota
+        state[0][0] ^= rc
+
+
+def keccak256(data: bytes) -> bytes:
+    rate = 136  # 1088-bit rate for 256-bit output
+    state = [[0] * 5 for _ in range(5)]
+    # pad10*1 with the 0x01 domain byte (legacy keccak)
+    padded = bytearray(data)
+    pad_len = rate - (len(padded) % rate)
+    padded += b"\x01" + b"\x00" * (pad_len - 2) + b"\x80" if pad_len >= 2 \
+        else b"\x81"
+    for block_off in range(0, len(padded), rate):
+        block = padded[block_off:block_off + rate]
+        for i in range(rate // 8):
+            lane = int.from_bytes(block[8 * i:8 * i + 8], "little")
+            state[i % 5][i // 5] ^= lane
+        _keccak_f(state)
+    out = bytearray()
+    for i in range(4):  # 32 bytes = 4 lanes
+        out += state[i % 5][i // 5].to_bytes(8, "little")
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# Minimal RLP
+# ---------------------------------------------------------------------------
+
+
+def rlp_encode(item) -> bytes:
+    if isinstance(item, int):
+        if item == 0:
+            item = b""
+        else:
+            item = item.to_bytes((item.bit_length() + 7) // 8, "big")
+    if isinstance(item, (bytes, bytearray)):
+        item = bytes(item)
+        if len(item) == 1 and item[0] < 0x80:
+            return item
+        return _rlp_len(len(item), 0x80) + item
+    if isinstance(item, (list, tuple)):
+        body = b"".join(rlp_encode(x) for x in item)
+        return _rlp_len(len(body), 0xC0) + body
+    raise EnrError(f"cannot RLP-encode {type(item)}")
+
+
+def _rlp_len(n: int, offset: int) -> bytes:
+    if n < 56:
+        return bytes([offset + n])
+    nb = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    return bytes([offset + 55 + len(nb)]) + nb
+
+
+def rlp_decode(data: bytes):
+    item, rest = _rlp_decode_one(memoryview(data))
+    if rest:
+        raise EnrError("trailing RLP bytes")
+    return item
+
+
+def _rlp_decode_one(mv: memoryview):
+    if not mv:
+        raise EnrError("empty RLP")
+    b0 = mv[0]
+    if b0 < 0x80:
+        return bytes(mv[:1]), mv[1:]
+    if b0 < 0xB8:
+        n = b0 - 0x80
+        if len(mv) < 1 + n:
+            raise EnrError("short RLP string")
+        s = bytes(mv[1:1 + n])
+        if n == 1 and s[0] < 0x80:
+            raise EnrError("non-canonical RLP single byte")
+        return s, mv[1 + n:]
+    if b0 < 0xC0:
+        ln = b0 - 0xB7
+        n = int.from_bytes(bytes(mv[1:1 + ln]), "big")
+        if n < 56 or len(mv) < 1 + ln + n:
+            raise EnrError("bad long RLP string")
+        return bytes(mv[1 + ln:1 + ln + n]), mv[1 + ln + n:]
+    if b0 < 0xF8:
+        n = b0 - 0xC0
+        body = mv[1:1 + n]
+        if len(body) < n:
+            raise EnrError("short RLP list")
+        rest = mv[1 + n:]
+    else:
+        ln = b0 - 0xF7
+        n = int.from_bytes(bytes(mv[1:1 + ln]), "big")
+        if n < 56 or len(mv) < 1 + ln + n:
+            raise EnrError("bad long RLP list")
+        body = mv[1 + ln:1 + ln + n]
+        rest = mv[1 + ln + n:]
+    items = []
+    while body:
+        item, body = _rlp_decode_one(body)
+        items.append(item)
+    return items, rest
+
+
+# ---------------------------------------------------------------------------
+# secp256k1 v4 identity scheme
+# ---------------------------------------------------------------------------
+
+
+def generate_key() -> ec.EllipticCurvePrivateKey:
+    return ec.generate_private_key(ec.SECP256K1())
+
+
+def private_key_from_bytes(raw: bytes) -> ec.EllipticCurvePrivateKey:
+    return ec.derive_private_key(
+        int.from_bytes(raw, "big"), ec.SECP256K1()
+    )
+
+
+def compressed_pubkey(key) -> bytes:
+    pub = key.public_key() if hasattr(key, "public_key") else key
+    nums = pub.public_numbers()
+    return bytes([2 + (nums.y & 1)]) + nums.x.to_bytes(32, "big")
+
+
+def _pubkey_from_compressed(data: bytes) -> ec.EllipticCurvePublicKey:
+    return ec.EllipticCurvePublicKey.from_encoded_point(
+        ec.SECP256K1(), data
+    )
+
+
+_SECP_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+
+
+def _sign_v4(key: ec.EllipticCurvePrivateKey, content: bytes) -> bytes:
+    digest = keccak256(content)
+    der = key.sign(digest, ec.ECDSA(Prehashed(hashes.SHA256())))
+    r, s = decode_dss_signature(der)
+    if s > _SECP_N // 2:   # low-s normalization (canonical signatures)
+        s = _SECP_N - s
+    return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+
+def _verify_v4(pubkey: bytes, content: bytes, sig: bytes) -> bool:
+    if len(sig) != 64:
+        return False
+    digest = keccak256(content)
+    der = encode_dss_signature(
+        int.from_bytes(sig[:32], "big"), int.from_bytes(sig[32:], "big")
+    )
+    try:
+        _pubkey_from_compressed(pubkey).verify(
+            der, digest, ec.ECDSA(Prehashed(hashes.SHA256()))
+        )
+        return True
+    except Exception:
+        return False
+
+
+def node_id_of(pubkey_compressed: bytes) -> bytes:
+    """keccak256(uncompressed x||y) — the discv5 DHT address."""
+    pub = _pubkey_from_compressed(pubkey_compressed).public_numbers()
+    return keccak256(pub.x.to_bytes(32, "big") + pub.y.to_bytes(32, "big"))
+
+
+# ---------------------------------------------------------------------------
+# The record
+# ---------------------------------------------------------------------------
+
+
+class Enr:
+    """An EIP-778 record: seq + sorted (k, v) pairs + v4 signature."""
+
+    def __init__(self, seq: int, pairs: Dict[bytes, bytes],
+                 signature: bytes):
+        self.seq = seq
+        self.pairs = dict(pairs)
+        self.signature = signature
+
+    # ------------------------------------------------------------- building
+
+    @classmethod
+    def build(cls, key: ec.EllipticCurvePrivateKey, seq: int = 1,
+              ip: Optional[str] = None, tcp: Optional[int] = None,
+              udp: Optional[int] = None,
+              eth2: Optional[bytes] = None,
+              attnets: Optional[bytes] = None,
+              syncnets: Optional[bytes] = None,
+              extra: Optional[Dict[bytes, bytes]] = None) -> "Enr":
+        pairs: Dict[bytes, bytes] = {
+            b"id": b"v4",
+            b"secp256k1": compressed_pubkey(key),
+        }
+        if ip is not None:
+            import socket as _socket
+
+            pairs[b"ip"] = _socket.inet_aton(ip)
+        if tcp is not None:
+            pairs[b"tcp"] = tcp.to_bytes(2, "big")
+        if udp is not None:
+            pairs[b"udp"] = udp.to_bytes(2, "big")
+        # eth2 fields (discovery/enr.rs:22-26)
+        if eth2 is not None:
+            pairs[b"eth2"] = eth2
+        if attnets is not None:
+            pairs[b"attnets"] = attnets
+        if syncnets is not None:
+            pairs[b"syncnets"] = syncnets
+        if extra:
+            pairs.update(extra)
+        content = cls._content_rlp(seq, pairs)
+        signature = _sign_v4(key, content)
+        enr = cls(seq, pairs, signature)
+        if len(enr.to_rlp()) > MAX_ENR_SIZE:
+            raise EnrError("record exceeds 300 bytes")
+        return enr
+
+    @staticmethod
+    def _content_rlp(seq: int, pairs: Dict[bytes, bytes]) -> bytes:
+        items: List = [seq]
+        for k in sorted(pairs):
+            items.extend([k, pairs[k]])
+        return rlp_encode(items)
+
+    def with_updates(self, key, **kwargs) -> "Enr":
+        """Re-sign with seq + 1 and updated fields (enr update on config
+        change; the reference bumps seq the same way)."""
+        merged = dict(self.pairs)
+        extra = kwargs.pop("extra", None) or {}
+        mapping = {"ip": b"ip", "tcp": b"tcp", "udp": b"udp",
+                   "eth2": b"eth2", "attnets": b"attnets",
+                   "syncnets": b"syncnets"}
+        for name, raw_key in mapping.items():
+            if name in kwargs and kwargs[name] is not None:
+                v = kwargs[name]
+                if name == "ip":
+                    import socket as _socket
+
+                    v = _socket.inet_aton(v)
+                elif name in ("tcp", "udp"):
+                    v = v.to_bytes(2, "big")
+                merged[raw_key] = v
+        merged.update(extra)
+        content = self._content_rlp(self.seq + 1, merged)
+        return Enr(self.seq + 1, merged, _sign_v4(key, content))
+
+    # ------------------------------------------------------------ accessors
+
+    @property
+    def pubkey(self) -> bytes:
+        return self.pairs[b"secp256k1"]
+
+    @property
+    def node_id(self) -> bytes:
+        return node_id_of(self.pubkey)
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self.pairs.get(key)
+
+    @property
+    def ip(self) -> Optional[str]:
+        raw = self.pairs.get(b"ip")
+        if raw is None:
+            return None
+        import socket as _socket
+
+        return _socket.inet_ntoa(raw)
+
+    @property
+    def tcp(self) -> Optional[int]:
+        raw = self.pairs.get(b"tcp")
+        return int.from_bytes(raw, "big") if raw else None
+
+    @property
+    def udp(self) -> Optional[int]:
+        raw = self.pairs.get(b"udp")
+        return int.from_bytes(raw, "big") if raw else None
+
+    def verify(self) -> bool:
+        if self.pairs.get(b"id") != b"v4":
+            return False
+        content = self._content_rlp(self.seq, self.pairs)
+        return _verify_v4(self.pubkey, content, self.signature)
+
+    # ---------------------------------------------------------------- codec
+
+    def to_rlp(self) -> bytes:
+        items: List = [self.signature, self.seq]
+        for k in sorted(self.pairs):
+            items.extend([k, self.pairs[k]])
+        return rlp_encode(items)
+
+    @classmethod
+    def from_rlp(cls, data: bytes) -> "Enr":
+        if len(data) > MAX_ENR_SIZE:
+            raise EnrError("record exceeds 300 bytes")
+        items = rlp_decode(data)
+        if not isinstance(items, list) or len(items) < 2 or \
+                (len(items) - 2) % 2 != 0:
+            raise EnrError("malformed record list")
+        signature = items[0]
+        seq = int.from_bytes(items[1], "big") if items[1] else 0
+        pairs: Dict[bytes, bytes] = {}
+        last = None
+        for i in range(2, len(items), 2):
+            k, v = items[i], items[i + 1]
+            if not isinstance(k, bytes) or not isinstance(v, bytes):
+                raise EnrError("nested values unsupported")
+            if last is not None and k <= last:
+                raise EnrError("keys not strictly sorted")
+            last = k
+            pairs[k] = v
+        enr = cls(seq, pairs, signature)
+        if not enr.verify():
+            raise EnrError("invalid signature")
+        return enr
+
+    def to_text(self) -> str:
+        return "enr:" + base64.urlsafe_b64encode(
+            self.to_rlp()).rstrip(b"=").decode()
+
+    @classmethod
+    def from_text(cls, text: str) -> "Enr":
+        if not text.startswith("enr:"):
+            raise EnrError("missing enr: prefix")
+        raw = text[4:]
+        raw += "=" * (-len(raw) % 4)
+        return cls.from_rlp(base64.urlsafe_b64decode(raw))
+
+    # ------------------------------------------------------------------ dht
+
+    def distance_to(self, other_id: bytes) -> int:
+        """discv5 XOR log-distance (the Kademlia metric)."""
+        x = int.from_bytes(self.node_id, "big") ^ int.from_bytes(
+            other_id, "big")
+        return x.bit_length()
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Enr) and self.to_rlp() == other.to_rlp()
+
+    def __repr__(self) -> str:
+        return (f"Enr(seq={self.seq}, id={self.node_id.hex()[:12]}…, "
+                f"ip={self.ip}, tcp={self.tcp}, udp={self.udp})")
+
+
+# ---------------------------------------------------------------------------
+# eth2 extension accessors (enr_ext.rs / discovery/enr.rs:22-26)
+# ---------------------------------------------------------------------------
+
+
+def _bitfield_bit(raw: Optional[bytes], i: int) -> bool:
+    """SSZ Bitvector bit order: bit i lives at byte i//8, bit i%8."""
+    if raw is None or i // 8 >= len(raw):
+        return False
+    return bool((raw[i // 8] >> (i % 8)) & 1)
+
+
+def bitfield_bytes(bits: int, n_bytes: int) -> bytes:
+    """int bitfield (bit i = subnet i) -> SSZ Bitvector bytes."""
+    out = bytearray(n_bytes)
+    for i in range(n_bytes * 8):
+        if (bits >> i) & 1:
+            out[i // 8] |= 1 << (i % 8)
+    return bytes(out)
+
+
+def _enr_peer_id(self) -> str:
+    """Transport address: the in-repo fabric's string peer id rides a
+    custom `pid` pair (EIP-778 allows arbitrary keys); real discv5 peers
+    without one address by node id."""
+    raw = self.pairs.get(b"pid")
+    return raw.decode() if raw is not None else self.node_id.hex()
+
+
+def _enr_attnets_int(self) -> int:
+    raw = self.pairs.get(b"attnets") or b""
+    return int.from_bytes(raw, "little")
+
+
+def _enr_subscribed_to_attnet(self, subnet: int) -> bool:
+    return _bitfield_bit(self.pairs.get(b"attnets"), subnet)
+
+
+def _enr_subscribed_to_syncnet(self, subnet: int) -> bool:
+    return _bitfield_bit(self.pairs.get(b"syncnets"), subnet)
+
+
+def _enr_fork_digest(self) -> Optional[bytes]:
+    """First 4 bytes of the `eth2` ENRForkID ssz (fork digest)."""
+    raw = self.pairs.get(b"eth2")
+    return bytes(raw[:4]) if raw else None
+
+
+Enr.peer_id = property(_enr_peer_id)
+Enr.attnets_int = property(_enr_attnets_int)
+Enr.subscribed_to_attnet = _enr_subscribed_to_attnet
+Enr.subscribed_to_syncnet = _enr_subscribed_to_syncnet
+Enr.fork_digest = property(_enr_fork_digest)

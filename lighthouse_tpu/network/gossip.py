@@ -1,12 +1,19 @@
 """Gossip pubsub — mesh-based topic fan-out with validation + scoring.
 
 Mirror of the vendored gossipsub fork (lighthouse_network/src/gossipsub/,
-SURVEY.md §5.8) reduced to the mechanisms the node depends on: per-topic
-mesh (D_lo=6/D=8/D_hi=12), GRAFT/PRUNE control on subscribe + heartbeat,
-seen-message dedup cache, fanout publish for unsubscribed topics, and the
-validation pipeline — a message is forwarded ONLY if the application
-validator ACCEPTs it; REJECT reports the sender to the peer manager
-(the accept/ignore/reject tri-state of gossipsub validation).
+SURVEY.md §5.8): per-topic mesh (D_lo=6/D=8/D_hi=12), GRAFT/PRUNE
+control on subscribe + heartbeat, IHAVE/IWANT lazy gossip backed by a
+windowed message cache (mcache.rs), seen-message dedup, fanout publish
+for unsubscribed topics, and the validation pipeline — a message is
+forwarded ONLY if the application validator ACCEPTs it; REJECT reports
+the sender to the peer manager (the accept/ignore/reject tri-state).
+
+Round 3 wire format: every gossip-layer exchange is ONE frame
+("gs", rpc_bytes) where rpc_bytes is the REAL gossipsub protobuf RPC
+envelope (pubsub_pb.py, byte-compatible with gossipsub/generated/
+rpc.proto) under eth2's StrictNoSign policy — messages carrying
+from/seqno/signature/key are rejected and the sender penalized
+(consensus p2p spec).
 
 Transport-agnostic: `transport.send(src, dst, frame)` delivers to the
 destination's `handle_frame(src, frame)`. `SimTransport` wires nodes
@@ -24,10 +31,16 @@ import threading
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Set
 
+from . import pubsub_pb
 from .peer_manager import PeerAction, PeerManager
 
 D_LO, D, D_HI = 6, 8, 12
 SEEN_CACHE_SIZE = 16384
+MCACHE_SIZE = 1024         # cached full messages (IWANT serving)
+GOSSIP_LAZY = 6            # IHAVE targets per heartbeat (D_lazy)
+PRUNE_BACKOFF_SECS = 60    # gossipsub v1.1 prune backoff we advertise
+MAX_IHAVE_IDS = 64         # ids honored per IHAVE control frame
+MAX_IWANT_PENDING = 4096   # outstanding gossip-promise cap
 
 ACCEPT = "accept"
 IGNORE = "ignore"
@@ -107,6 +120,9 @@ class GossipNode:
         self.validators: Dict[str, Callable[[str, bytes, str], str]] = {}
         self.handlers: Dict[str, Callable[[str, bytes, str], None]] = {}
         self._seen: "OrderedDict[bytes, bool]" = OrderedDict()
+        # mcache: mid -> (topic, wire_data) for IWANT serving (mcache.rs).
+        self._mcache: "OrderedDict[bytes, tuple]" = OrderedDict()
+        self._iwant_pending: Set[bytes] = set()
         self._lock = threading.RLock()
         if hasattr(transport, "register"):
             transport.register(self)
@@ -118,8 +134,10 @@ class GossipNode:
             if not self.peer_manager.peer_connected(peer_id):
                 return
             self.peers.add(peer_id)
-            for topic in self.subscriptions:
-                self._send(peer_id, ("subscribe", topic))
+            if self.subscriptions:
+                self._send_rpc(peer_id, {"subscriptions": [
+                    (True, t) for t in self.subscriptions
+                ]})
 
     def peer_disconnected(self, peer_id: str) -> None:
         with self._lock:
@@ -143,16 +161,17 @@ class GossipNode:
                 self.handlers[topic] = handler
             self.mesh.setdefault(topic, set())
             for p in self.peers:
-                self._send(p, ("subscribe", topic))
+                self._send_rpc(p, {"subscriptions": [(True, topic)]})
             self._maintain_mesh(topic)
 
     def unsubscribe(self, topic: str) -> None:
         with self._lock:
             self.subscriptions.discard(topic)
             for p in self.mesh.pop(topic, set()):
-                self._send(p, ("prune", topic))
+                self._send_rpc(p, {"control": {
+                    "prune": [(topic, PRUNE_BACKOFF_SECS)]}})
             for p in self.peers:
-                self._send(p, ("unsubscribe", topic))
+                self._send_rpc(p, {"subscriptions": [(False, topic)]})
 
     # --------------------------------------------------------------- publish
 
@@ -166,6 +185,7 @@ class GossipNode:
         with self._lock:
             mid = _id_from_body(topic, body, MESSAGE_DOMAIN_VALID_SNAPPY)
             self._mark_seen(mid)
+            self._mcache_put(mid, topic, data)
             if topic in self.subscriptions:
                 targets = set(self.mesh.get(topic, set()))
             else:
@@ -176,39 +196,83 @@ class GossipNode:
                     fan.update(candidates[:D])
                 targets = set(fan)
             for p in targets:
-                self._send(p, ("gossip", topic, mid, data, self.peer_id))
+                self._send_rpc(p, {"publish": [
+                    {"topic": topic, "data": data}]})
             return len(targets)
 
     # ---------------------------------------------------------------- frames
 
     def handle_frame(self, src: str, frame: tuple) -> None:
-        kind = frame[0]
+        if frame[0] != "gs":
+            return
+        try:
+            rpc = pubsub_pb.decode_rpc(frame[1])
+        except pubsub_pb.PbError:
+            self.peer_manager.report_peer(src, PeerAction.LOW_TOLERANCE)
+            return
         with self._lock:
             if self.peer_manager.is_banned(src):
                 return
-            if kind == "subscribe":
-                self.peer_topics.setdefault(frame[1], set()).add(src)
-                if frame[1] in self.subscriptions:
-                    self._maintain_mesh(frame[1])
-            elif kind == "unsubscribe":
-                self.peer_topics.get(frame[1], set()).discard(src)
-                self.mesh.get(frame[1], set()).discard(src)
-            elif kind == "graft":
-                topic = frame[1]
+            for subscribe, topic in rpc["subscriptions"]:
+                if subscribe:
+                    self.peer_topics.setdefault(topic, set()).add(src)
+                    if topic in self.subscriptions:
+                        self._maintain_mesh(topic)
+                else:
+                    self.peer_topics.get(topic, set()).discard(src)
+                    self.mesh.get(topic, set()).discard(src)
+            control = rpc["control"] or {}
+            for topic in control.get("graft", []):
                 if topic in self.subscriptions:
                     self.mesh.setdefault(topic, set()).add(src)
                 else:
-                    self._send(src, ("prune", topic))
-            elif kind == "prune":
-                self.mesh.get(frame[1], set()).discard(src)
-            elif kind == "gossip":
-                self._handle_gossip(src, frame)
+                    self._send_rpc(src, {"control": {
+                        "prune": [(topic, PRUNE_BACKOFF_SECS)]}})
+            for topic, _backoff in control.get("prune", []):
+                self.mesh.get(topic, set()).discard(src)
+            self._handle_ihave_iwant(src, control)
+            for msg in rpc["publish"]:
+                self._handle_gossip(src, msg)
 
-    def _handle_gossip(self, src: str, frame: tuple) -> None:
-        _, topic, _claimed_mid, data, origin = frame
+    def _handle_ihave_iwant(self, src: str, control: dict) -> None:
+        # IHAVE: request unseen ids (gossip_promises.rs tracks these).
+        # Bounded against IHAVE floods: only subscribed topics count, at
+        # most MAX_IHAVE_IDS ids per control frame, and the outstanding-
+        # promise set is capped (real gossipsub's max_ihave_length +
+        # gossip-promise expiry play the same role).
+        want: List[bytes] = []
+        for topic, mids in control.get("ihave", []):
+            if topic not in self.subscriptions:
+                continue
+            for mid in mids[:MAX_IHAVE_IDS]:
+                if len(want) >= MAX_IHAVE_IDS or \
+                        len(self._iwant_pending) >= MAX_IWANT_PENDING:
+                    break
+                if mid not in self._seen and mid not in self._iwant_pending:
+                    self._iwant_pending.add(mid)
+                    want.append(mid)
+        if want:
+            self._send_rpc(src, {"control": {"iwant": [want]}})
+        # IWANT: serve from the message cache.
+        serve = []
+        for mids in control.get("iwant", []):
+            for mid in mids:
+                hit = self._mcache.get(mid)
+                if hit is not None:
+                    serve.append({"topic": hit[0], "data": hit[1]})
+        if serve:
+            self._send_rpc(src, {"publish": serve})
+
+    def _handle_gossip(self, src: str, msg: dict) -> None:
+        topic, data = msg["topic"], msg["data"]
+        if msg.get("signed_fields"):
+            # StrictNoSign: signed/attributed messages are protocol
+            # violations on eth2 topics (p2p spec) — penalize and drop.
+            self.peer_manager.report_peer(src, PeerAction.LOW_TOLERANCE)
+            return
         # The message id is RECOMPUTED from the payload (see message_id):
-        # the claimed id is ignored, so junk data cannot poison the seen
-        # cache against a future legitimate message.
+        # ids are never trusted from the wire, so junk data cannot poison
+        # the seen cache against a future legitimate message.
         try:
             body = _snappy.decompress(data, MAX_GOSSIP_SIZE)
         except _snappy.SnappyError:
@@ -216,6 +280,7 @@ class GossipNode:
             self.peer_manager.report_peer(src, PeerAction.LOW_TOLERANCE)
             return
         mid = _id_from_body(topic, body, MESSAGE_DOMAIN_VALID_SNAPPY)
+        self._iwant_pending.discard(mid)
         if mid in self._seen:
             return
         self._mark_seen(mid)
@@ -225,7 +290,7 @@ class GossipNode:
         validator = self.validators.get(topic)
         if validator is not None:
             try:
-                verdict = validator(topic, body, origin)
+                verdict = validator(topic, body, src)
             except Exception:
                 verdict = REJECT
         if verdict == REJECT:
@@ -233,13 +298,15 @@ class GossipNode:
             return
         if verdict == IGNORE:
             return
+        self._mcache_put(mid, topic, data)
         handler = self.handlers.get(topic)
         if handler is not None:
-            handler(topic, body, origin)
+            handler(topic, body, src)
         # forward to the mesh (except where it came from)
         for p in self.mesh.get(topic, set()):
-            if p != src and p != origin:
-                self._send(p, ("gossip", topic, mid, data, origin))
+            if p != src:
+                self._send_rpc(p, {"publish": [
+                    {"topic": topic, "data": data}]})
 
     # ------------------------------------------------------------- heartbeat
 
@@ -247,7 +314,29 @@ class GossipNode:
         with self._lock:
             for topic in list(self.subscriptions):
                 self._maintain_mesh(topic)
+                self._emit_gossip(topic)
+            # Gossip promises expire each heartbeat: an advertised message
+            # that never arrived frees its slot (and may be re-requested).
+            self._iwant_pending.clear()
             self.peer_manager.heartbeat()
+
+    def _emit_gossip(self, topic: str) -> None:
+        """Lazy gossip (the 'gossip' in gossipsub): advertise recent
+        message ids to D_lazy NON-mesh subscribers so eclipse/partition
+        holes heal via IWANT pulls."""
+        recent = [mid for mid, (t, _d) in self._mcache.items() if t == topic]
+        if not recent:
+            return
+        mesh = self.mesh.get(topic, set())
+        candidates = [
+            p for p in self.peer_topics.get(topic, set())
+            if p in self.peers and p not in mesh
+            and not self.peer_manager.is_banned(p)
+        ]
+        self.rng.shuffle(candidates)
+        for p in candidates[:GOSSIP_LAZY]:
+            self._send_rpc(p, {"control": {
+                "ihave": [(topic, recent[-64:])]}})
 
     def _maintain_mesh(self, topic: str) -> None:
         mesh = self.mesh.setdefault(topic, set())
@@ -261,13 +350,14 @@ class GossipNode:
             self.rng.shuffle(candidates)
             for p in candidates[: D - len(mesh)]:
                 mesh.add(p)
-                self._send(p, ("graft", topic))
+                self._send_rpc(p, {"control": {"graft": [topic]}})
         elif len(mesh) > D_HI:
             excess = list(mesh)
             self.rng.shuffle(excess)
             for p in excess[: len(mesh) - D]:
                 mesh.discard(p)
-                self._send(p, ("prune", topic))
+                self._send_rpc(p, {"control": {
+                    "prune": [(topic, PRUNE_BACKOFF_SECS)]}})
 
     # ------------------------------------------------------------------ util
 
@@ -276,5 +366,12 @@ class GossipNode:
         while len(self._seen) > SEEN_CACHE_SIZE:
             self._seen.popitem(last=False)
 
-    def _send(self, dst: str, frame: tuple) -> None:
-        self.transport.send(self.peer_id, dst, frame)
+    def _mcache_put(self, mid: bytes, topic: str, data: bytes) -> None:
+        self._mcache[mid] = (topic, data)
+        while len(self._mcache) > MCACHE_SIZE:
+            self._mcache.popitem(last=False)
+
+    def _send_rpc(self, dst: str, rpc: dict) -> None:
+        self.transport.send(
+            self.peer_id, dst, ("gs", pubsub_pb.encode_rpc(rpc))
+        )

@@ -1,0 +1,281 @@
+"""Noise XX transport security — Noise_XX_25519_ChaChaPoly_SHA256.
+
+The reference encrypts every libp2p connection with the noise protocol
+(beacon_node/lighthouse_network/src/service/utils.rs build_transport:
+`noise::Config::new`). This is a from-scratch implementation of the same
+handshake pattern over the repo's TCP fabric: X25519 DH, ChaCha20-
+Poly1305 AEAD, SHA-256 symmetric-state hashing, exactly per the Noise
+spec (revision 34) — XX gives mutual static-key authentication with
+identity hiding:
+
+    -> e
+    <- e, ee, s, es
+    -> s, se
+
+libp2p's extra payload (identity-key signature binding the noise static
+to the peer id) is mirrored in reduced form: each side's handshake
+payload carries its transport peer id, authenticated by the handshake
+hash; `remote_payload` surfaces it to the caller for the hello binding.
+
+After Split(), `NoiseSession.encrypt/decrypt` carry the stream: 8-byte
+little-endian counter nonces, MAC failure raises and the transport drops
+the connection (tamper test in tests/test_network.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+from typing import Optional, Tuple
+
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+from cryptography.hazmat.primitives.serialization import (
+    Encoding,
+    PublicFormat,
+)
+
+PROTOCOL_NAME = b"Noise_XX_25519_ChaChaPoly_SHA256"
+
+
+class NoiseError(Exception):
+    pass
+
+
+def _sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def _hmac(key: bytes, data: bytes) -> bytes:
+    return hmac.new(key, data, hashlib.sha256).digest()
+
+
+def _hkdf2(ck: bytes, ikm: bytes) -> Tuple[bytes, bytes]:
+    """Noise HKDF with two outputs (spec §4.3)."""
+    temp = _hmac(ck, ikm)
+    out1 = _hmac(temp, b"\x01")
+    out2 = _hmac(temp, out1 + b"\x02")
+    return out1, out2
+
+
+def _pub_bytes(priv: X25519PrivateKey) -> bytes:
+    return priv.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
+
+
+def _dh(priv: X25519PrivateKey, pub: bytes) -> bytes:
+    return priv.exchange(X25519PublicKey.from_public_bytes(pub))
+
+
+class CipherState:
+    """k + 64-bit counter nonce (spec §5.1); nonce rides little-endian in
+    the final 8 bytes of the 12-byte ChaCha20-Poly1305 IV."""
+
+    def __init__(self, k: Optional[bytes] = None):
+        self.k = k
+        self.n = 0
+
+    def _iv(self) -> bytes:
+        return b"\x00" * 4 + self.n.to_bytes(8, "little")
+
+    def encrypt(self, ad: bytes, pt: bytes) -> bytes:
+        if self.k is None:
+            return pt
+        ct = ChaCha20Poly1305(self.k).encrypt(self._iv(), pt, ad)
+        self.n += 1
+        return ct
+
+    def decrypt(self, ad: bytes, ct: bytes) -> bytes:
+        if self.k is None:
+            return ct
+        try:
+            pt = ChaCha20Poly1305(self.k).decrypt(self._iv(), ct, ad)
+        except Exception:
+            raise NoiseError("AEAD authentication failed")
+        self.n += 1
+        return pt
+
+
+class SymmetricState:
+    def __init__(self):
+        self.h = _sha256(PROTOCOL_NAME) if len(PROTOCOL_NAME) > 32 \
+            else PROTOCOL_NAME.ljust(32, b"\x00")
+        self.ck = self.h
+        self.cipher = CipherState()
+
+    def mix_hash(self, data: bytes) -> None:
+        self.h = _sha256(self.h + data)
+
+    def mix_key(self, ikm: bytes) -> None:
+        self.ck, temp_k = _hkdf2(self.ck, ikm)
+        self.cipher = CipherState(temp_k)
+
+    def encrypt_and_hash(self, pt: bytes) -> bytes:
+        ct = self.cipher.encrypt(self.h, pt)
+        self.mix_hash(ct)
+        return ct
+
+    def decrypt_and_hash(self, ct: bytes) -> bytes:
+        pt = self.cipher.decrypt(self.h, ct)
+        self.mix_hash(ct)
+        return pt
+
+    def split(self) -> Tuple[CipherState, CipherState]:
+        k1, k2 = _hkdf2(self.ck, b"")
+        return CipherState(k1), CipherState(k2)
+
+
+class NoiseHandshake:
+    """One side of an XX handshake. Drive with write_message/read_message
+    in pattern order; `session()` returns the transport ciphers once
+    complete."""
+
+    def __init__(self, initiator: bool, payload: bytes = b"",
+                 static_key: Optional[X25519PrivateKey] = None):
+        self.initiator = initiator
+        self.payload = payload
+        self.s = static_key or X25519PrivateKey.generate()
+        self.e: Optional[X25519PrivateKey] = None
+        self.rs: Optional[bytes] = None          # remote static
+        self.re: Optional[bytes] = None          # remote ephemeral
+        self.remote_payload: Optional[bytes] = None
+        self.ss = SymmetricState()
+        self.ss.mix_hash(b"")                    # empty prologue
+        self._msg = 0
+        self.complete = False
+        self._send_cipher: Optional[CipherState] = None
+        self._recv_cipher: Optional[CipherState] = None
+
+    # -- message 1: -> e -----------------------------------------------------
+
+    def _write_e(self) -> bytes:
+        self.e = X25519PrivateKey.generate()
+        e_pub = _pub_bytes(self.e)
+        self.ss.mix_hash(e_pub)
+        return e_pub
+
+    def write_message(self) -> bytes:
+        if self.initiator and self._msg == 0:
+            self._msg = 1
+            return self._write_e() + self.ss.encrypt_and_hash(b"")
+        if not self.initiator and self._msg == 1:
+            # <- e, ee, s, es
+            out = self._write_e()
+            self.ss.mix_key(_dh(self.e, self.re))            # ee
+            out += self.ss.encrypt_and_hash(_pub_bytes(self.s))
+            self.ss.mix_key(_dh(self.s, self.re))            # es
+            out += self.ss.encrypt_and_hash(self.payload)
+            self._msg = 2
+            return out
+        if self.initiator and self._msg == 2:
+            # -> s, se
+            out = self.ss.encrypt_and_hash(_pub_bytes(self.s))
+            self.ss.mix_key(_dh(self.s, self.re))            # se
+            out += self.ss.encrypt_and_hash(self.payload)
+            self._finish()
+            return out
+        raise NoiseError("write_message out of order")
+
+    def read_message(self, data: bytes) -> None:
+        if not self.initiator and self._msg == 0:
+            if len(data) < 32:
+                raise NoiseError("short message 1")
+            self.re = data[:32]
+            self.ss.mix_hash(self.re)
+            self.ss.decrypt_and_hash(data[32:])
+            self._msg = 1
+            return
+        if self.initiator and self._msg == 1:
+            if len(data) < 32 + 48:
+                raise NoiseError("short message 2")
+            self.re = data[:32]
+            self.ss.mix_hash(self.re)
+            self.ss.mix_key(_dh(self.e, self.re))            # ee
+            self.rs = self.ss.decrypt_and_hash(data[32:32 + 48])
+            self.ss.mix_key(_dh(self.e, self.rs))            # es
+            self.remote_payload = self.ss.decrypt_and_hash(data[32 + 48:])
+            self._msg = 2
+            return
+        if not self.initiator and self._msg == 2:
+            if len(data) < 48:
+                raise NoiseError("short message 3")
+            self.rs = self.ss.decrypt_and_hash(data[:48])
+            self.ss.mix_key(_dh(self.e, self.rs))            # se
+            self.remote_payload = self.ss.decrypt_and_hash(data[48:])
+            self._finish()
+            return
+        raise NoiseError("read_message out of order")
+
+    def _finish(self) -> None:
+        c1, c2 = self.ss.split()
+        # Initiator sends with c1, receives with c2 (spec §5.3).
+        if self.initiator:
+            self._send_cipher, self._recv_cipher = c1, c2
+        else:
+            self._send_cipher, self._recv_cipher = c2, c1
+        self.complete = True
+
+    def session(self) -> "NoiseSession":
+        if not self.complete:
+            raise NoiseError("handshake incomplete")
+        return NoiseSession(self._send_cipher, self._recv_cipher,
+                            self.ss.h, self.rs, self.remote_payload)
+
+
+class NoiseSession:
+    """Post-handshake transport ciphers (one direction each)."""
+
+    def __init__(self, send_cipher: CipherState, recv_cipher: CipherState,
+                 handshake_hash: bytes, remote_static: bytes,
+                 remote_payload: bytes):
+        self._send = send_cipher
+        self._recv = recv_cipher
+        self.handshake_hash = handshake_hash
+        self.remote_static = remote_static
+        self.remote_payload = remote_payload
+
+    def encrypt(self, pt: bytes) -> bytes:
+        return self._send.encrypt(b"", pt)
+
+    def decrypt(self, ct: bytes) -> bytes:
+        return self._recv.decrypt(b"", ct)
+
+
+def handshake_over_socket(sock, initiator: bool, payload: bytes = b"",
+                          static_key=None) -> NoiseSession:
+    """Run the 3-message XX handshake over a socket with 2-byte length
+    prefixes (noise spec §13 framing convention), returning the session."""
+    import struct
+
+    def send(data: bytes) -> None:
+        sock.sendall(struct.pack(">H", len(data)) + data)
+
+    def recv() -> bytes:
+        hdr = b""
+        while len(hdr) < 2:
+            chunk = sock.recv(2 - len(hdr))
+            if not chunk:
+                raise NoiseError("peer closed during handshake")
+            hdr += chunk
+        (n,) = struct.unpack(">H", hdr)
+        body = b""
+        while len(body) < n:
+            chunk = sock.recv(n - len(body))
+            if not chunk:
+                raise NoiseError("peer closed during handshake")
+            body += chunk
+        return body
+
+    hs = NoiseHandshake(initiator, payload=payload, static_key=static_key)
+    if initiator:
+        send(hs.write_message())
+        hs.read_message(recv())
+        send(hs.write_message())
+    else:
+        hs.read_message(recv())
+        send(hs.write_message())
+        hs.read_message(recv())
+    return hs.session()

@@ -1,0 +1,186 @@
+"""Gossipsub wire protocol — the protobuf RPC envelope.
+
+Hand-rolled proto2 codec for the libp2p pubsub schema the reference
+vendors (beacon_node/lighthouse_network/src/gossipsub/generated/
+rpc.proto): RPC{subscriptions, publish, control{ihave, iwant, graft,
+prune}}. Byte-compatible with any gossipsub implementation; eth2 runs
+the StrictNoSign message policy (from/seqno/signature/key absent —
+consensus spec p2p-interface.md), which encode_rpc enforces by simply
+never emitting those fields.
+
+RPC dict shape:
+    {"subscriptions": [(subscribe: bool, topic: str), ...],
+     "publish": [{"topic": str, "data": bytes}, ...],
+     "control": {"ihave": [(topic, [mid, ...]), ...],
+                 "iwant": [[mid, ...], ...],
+                 "graft": [topic, ...],
+                 "prune": [(topic, backoff_secs|None), ...]} | None}
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+
+class PbError(Exception):
+    pass
+
+
+# --- varint / field plumbing ------------------------------------------------
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    out = 0
+    shift = 0
+    while True:
+        if pos >= len(buf) or shift > 63:
+            raise PbError("truncated varint")
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def _ld(field: int, data: bytes) -> bytes:
+    """Length-delimited field (wire type 2)."""
+    return _varint((field << 3) | 2) + _varint(len(data)) + data
+
+
+def _vi(field: int, value: int) -> bytes:
+    """Varint field (wire type 0)."""
+    return _varint((field << 3) | 0) + _varint(value)
+
+
+def _fields(buf: bytes):
+    """Yield (field_number, wire_type, value) — value is int for varint,
+    bytes for length-delimited; unknown wire types raise."""
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        field, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, pos = _read_varint(buf, pos)
+            yield field, wt, v
+        elif wt == 2:
+            n, pos = _read_varint(buf, pos)
+            if pos + n > len(buf):
+                raise PbError("truncated field")
+            yield field, wt, buf[pos:pos + n]
+            pos += n
+        elif wt == 5:   # 32-bit — skip (not in schema, but tolerate)
+            if pos + 4 > len(buf):
+                raise PbError("truncated fixed32")
+            pos += 4
+        elif wt == 1:   # 64-bit
+            if pos + 8 > len(buf):
+                raise PbError("truncated fixed64")
+            pos += 8
+        else:
+            raise PbError(f"unsupported wire type {wt}")
+
+
+# --- encode -----------------------------------------------------------------
+
+
+def encode_rpc(rpc: Dict) -> bytes:
+    out = bytearray()
+    for subscribe, topic in rpc.get("subscriptions", []):
+        sub = _vi(1, 1 if subscribe else 0) + _ld(2, topic.encode())
+        out += _ld(1, sub)
+    for msg in rpc.get("publish", []):
+        # StrictNoSign: only topic (field 4) + data (field 2) on the wire.
+        body = _ld(2, msg["data"]) + _ld(4, msg["topic"].encode())
+        out += _ld(2, body)
+    control = rpc.get("control")
+    if control:
+        ctl = bytearray()
+        for topic, mids in control.get("ihave", []):
+            ih = _ld(1, topic.encode()) + b"".join(_ld(2, m) for m in mids)
+            ctl += _ld(1, ih)
+        for mids in control.get("iwant", []):
+            ctl += _ld(2, b"".join(_ld(1, m) for m in mids))
+        for topic in control.get("graft", []):
+            ctl += _ld(3, _ld(1, topic.encode()))
+        for item in control.get("prune", []):
+            topic, backoff = item if isinstance(item, tuple) else (item, None)
+            pr = _ld(1, topic.encode())
+            if backoff is not None:
+                pr += _vi(3, int(backoff))
+            ctl += _ld(4, pr)
+        out += _ld(3, bytes(ctl))
+    return bytes(out)
+
+
+# --- decode -----------------------------------------------------------------
+
+
+def decode_rpc(data: bytes) -> Dict:
+    subs: List[Tuple[bool, str]] = []
+    publish: List[Dict] = []
+    control: Optional[Dict] = None
+    for field, wt, v in _fields(data):
+        if field == 1 and wt == 2:
+            flag, topic = True, ""
+            for f2, w2, v2 in _fields(v):
+                if f2 == 1 and w2 == 0:
+                    flag = bool(v2)
+                elif f2 == 2 and w2 == 2:
+                    topic = v2.decode("utf-8", "replace")
+            subs.append((flag, topic))
+        elif field == 2 and wt == 2:
+            msg = {"topic": None, "data": b""}
+            for f2, w2, v2 in _fields(v):
+                if f2 == 2 and w2 == 2:
+                    msg["data"] = v2
+                elif f2 == 4 and w2 == 2:
+                    msg["topic"] = v2.decode("utf-8", "replace")
+                elif f2 in (1, 3, 5, 6):
+                    # from/seqno/signature/key: forbidden under
+                    # StrictNoSign — flag for the caller to penalize.
+                    msg["signed_fields"] = True
+            if msg["topic"] is None:
+                raise PbError("Message missing required topic")
+            publish.append(msg)
+        elif field == 3 and wt == 2:
+            control = {"ihave": [], "iwant": [], "graft": [], "prune": []}
+            for f2, w2, v2 in _fields(v):
+                if f2 == 1 and w2 == 2:       # ihave
+                    topic, mids = "", []
+                    for f3, w3, v3 in _fields(v2):
+                        if f3 == 1 and w3 == 2:
+                            topic = v3.decode("utf-8", "replace")
+                        elif f3 == 2 and w3 == 2:
+                            mids.append(v3)
+                    control["ihave"].append((topic, mids))
+                elif f2 == 2 and w2 == 2:     # iwant
+                    mids = [v3 for f3, w3, v3 in _fields(v2)
+                            if f3 == 1 and w3 == 2]
+                    control["iwant"].append(mids)
+                elif f2 == 3 and w2 == 2:     # graft
+                    for f3, w3, v3 in _fields(v2):
+                        if f3 == 1 and w3 == 2:
+                            control["graft"].append(
+                                v3.decode("utf-8", "replace"))
+                elif f2 == 4 and w2 == 2:     # prune
+                    topic, backoff = "", None
+                    for f3, w3, v3 in _fields(v2):
+                        if f3 == 1 and w3 == 2:
+                            topic = v3.decode("utf-8", "replace")
+                        elif f3 == 3 and w3 == 0:
+                            backoff = v3
+                    control["prune"].append((topic, backoff))
+    return {"subscriptions": subs, "publish": publish, "control": control}

@@ -120,13 +120,6 @@ def decode_wire(data: bytes):
     return obj
 
 
-def _pack(obj) -> bytes:
-    body = _snappy.frame_compress(encode_wire(obj))
-    if len(body) > MAX_FRAME:
-        raise ValueError("frame too large")
-    return struct.pack(">I", len(body)) + body
-
-
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     buf = b""
     while len(buf) < n:
@@ -147,17 +140,53 @@ def _decompress_capped(body: bytes) -> bytes:
         raise ValueError(str(e))
 
 
-def _recv_msg(sock: socket.socket):
-    hdr = _recv_exact(sock, 4)
-    if hdr is None:
-        return None
-    (n,) = struct.unpack(">I", hdr)
-    if n > MAX_FRAME:
-        raise ValueError("oversize frame")
-    body = _recv_exact(sock, n)
-    if body is None:
-        return None
-    return decode_wire(_decompress_capped(body))
+class _Conn:
+    """One TCP connection, optionally noise-encrypted (round 3: the
+    reference secures every libp2p connection with Noise XX,
+    service/utils.rs build_transport; network/noise.py is the from-scratch
+    XX implementation). Messages: 4-byte length || [noise-AEAD(] snappy-
+    framed envelope [)] — a flipped ciphertext bit fails the Poly1305 tag
+    and tears the connection down."""
+
+    def __init__(self, sock: socket.socket, session=None):
+        self.sock = sock
+        self.session = session
+
+    def send_msg(self, obj) -> None:
+        body = _snappy.frame_compress(encode_wire(obj))
+        if len(body) > MAX_FRAME:
+            raise ValueError("frame too large")
+        if self.session is not None:
+            body = self.session.encrypt(body)
+        self.sock.sendall(struct.pack(">I", len(body)) + body)
+
+    def recv_msg(self):
+        hdr = _recv_exact(self.sock, 4)
+        if hdr is None:
+            return None
+        (n,) = struct.unpack(">I", hdr)
+        if n > MAX_FRAME + 16:          # + Poly1305 tag when encrypted
+            raise ValueError("oversize frame")
+        body = _recv_exact(self.sock, n)
+        if body is None:
+            return None
+        if self.session is not None:
+            from .noise import NoiseError
+
+            try:
+                body = self.session.decrypt(body)
+            except NoiseError as e:
+                raise ValueError(str(e))    # reader loops drop the conn
+        return decode_wire(_decompress_capped(body))
+
+    def settimeout(self, t) -> None:
+        self.sock.settimeout(t)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
 
 
 # --- TCP transport ----------------------------------------------------------
@@ -169,9 +198,18 @@ class TcpTransport:
     down the matching connection. Accept + per-connection reader threads
     push inbound frames into the node's handle_frame (the swarm loop)."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 secure: bool = False, noise_static=None):
         self.node = None
-        self._conns: Dict[str, socket.socket] = {}
+        self.secure = secure
+        self._noise_static = noise_static
+        if secure and noise_static is None:
+            from cryptography.hazmat.primitives.asymmetric.x25519 import (
+                X25519PrivateKey,
+            )
+
+            self._noise_static = X25519PrivateKey.generate()
+        self._conns: Dict[str, _Conn] = {}
         self._send_locks: Dict[str, threading.Lock] = {}
         self._conn_lock = threading.Lock()
         self._peer_addrs: Dict[str, Tuple[str, int]] = {}
@@ -200,19 +238,34 @@ class TcpTransport:
     # -- dialing -------------------------------------------------------------
 
     def dial(self, addr: Tuple[str, int], timeout: float = 10.0) -> str:
-        """Connect, exchange hellos, start the reader. Returns the remote
-        peer_id."""
+        """Connect, [noise-handshake,] exchange hellos, start the reader.
+        Returns the remote peer_id."""
         sock = socket.create_connection(addr, timeout=timeout)
         sock.settimeout(timeout)
-        sock.sendall(_pack(("hello", self.peer_id,
-                            self.listen_addr[0], self.listen_addr[1])))
-        msg = _recv_msg(sock)
+        session = None
+        if self.secure:
+            from .noise import handshake_over_socket
+
+            session = handshake_over_socket(
+                sock, initiator=True, payload=self.peer_id.encode(),
+                static_key=self._noise_static,
+            )
+        conn = _Conn(sock, session)
+        conn.send_msg(("hello", self.peer_id,
+                       self.listen_addr[0], self.listen_addr[1]))
+        msg = conn.recv_msg()
         if not (isinstance(msg, tuple) and msg and msg[0] == "hello"):
-            sock.close()
+            conn.close()
             raise ConnectionError("bad hello from peer")
         _, remote_id, rhost, rport = msg
-        sock.settimeout(None)
-        self._add_conn(remote_id, sock, (rhost, rport), outbound=True)
+        if session is not None and \
+                session.remote_payload != remote_id.encode():
+            # The hello id must match the identity authenticated inside
+            # the noise handshake (libp2p's identity binding).
+            conn.close()
+            raise ConnectionError("hello id does not match noise identity")
+        conn.settimeout(None)
+        self._add_conn(remote_id, conn, (rhost, rport), outbound=True)
         return remote_id
 
     def _accept_loop(self) -> None:
@@ -228,31 +281,41 @@ class TcpTransport:
     def _handshake_inbound(self, sock: socket.socket) -> None:
         try:
             sock.settimeout(10.0)
-            msg = _recv_msg(sock)
+            session = None
+            if self.secure:
+                from .noise import handshake_over_socket
+
+                session = handshake_over_socket(
+                    sock, initiator=False, payload=self.peer_id.encode(),
+                    static_key=self._noise_static,
+                )
+            conn = _Conn(sock, session)
+            msg = conn.recv_msg()
             if not (isinstance(msg, tuple) and msg and msg[0] == "hello"):
-                sock.close()
+                conn.close()
                 return
             _, remote_id, rhost, rport = msg
-            sock.sendall(_pack(("hello", self.peer_id,
-                                self.listen_addr[0], self.listen_addr[1])))
-            sock.settimeout(None)
-            self._add_conn(remote_id, sock, (rhost, rport), outbound=False)
-        except (OSError, ValueError, struct.error, IndexError):
-            # Garbage hellos (port scanners, bad peers) must not leak the
-            # socket or kill the handshake thread.
+            if session is not None and \
+                    session.remote_payload != remote_id.encode():
+                conn.close()
+                return
+            conn.send_msg(("hello", self.peer_id,
+                           self.listen_addr[0], self.listen_addr[1]))
+            conn.settimeout(None)
+            self._add_conn(remote_id, conn, (rhost, rport), outbound=False)
+        except Exception:
+            # Garbage hellos (port scanners, bad peers, failed noise
+            # handshakes) must not leak the socket or kill the thread.
             try:
                 sock.close()
             except OSError:
                 pass
 
-    def _add_conn(self, remote_id: str, sock: socket.socket,
+    def _add_conn(self, remote_id: str, conn: _Conn,
                   addr: Tuple[str, int], outbound: bool) -> None:
         if remote_id == self.peer_id:
             # A dialer claiming OUR id is either a loop or an attack.
-            try:
-                sock.close()
-            except OSError:
-                pass
+            conn.close()
             return
         old = None
         with self._conn_lock:
@@ -269,29 +332,23 @@ class TcpTransport:
             else:
                 dup = False
                 old = existing          # outbound replace: evict stale conn
-                self._conns[remote_id] = sock
+                self._conns[remote_id] = conn
                 self._peer_addrs[remote_id] = addr
         if dup:
-            try:
-                sock.close()
-            except OSError:
-                pass
+            conn.close()
             return
         if old is not None:
-            try:
-                old.close()
-            except OSError:
-                pass
+            old.close()
         threading.Thread(
-            target=self._reader_loop, args=(remote_id, sock), daemon=True
+            target=self._reader_loop, args=(remote_id, conn), daemon=True
         ).start()
         if self.on_peer_connected is not None:
             self.on_peer_connected(remote_id)
 
-    def _reader_loop(self, remote_id: str, sock: socket.socket) -> None:
+    def _reader_loop(self, remote_id: str, conn: _Conn) -> None:
         try:
             while True:
-                msg = _recv_msg(sock)
+                msg = conn.recv_msg()
                 if msg is None:
                     break
                 if isinstance(msg, tuple) and msg and msg[0] == "frame":
@@ -304,34 +361,37 @@ class TcpTransport:
                         except Exception:
                             pass  # a bad frame must not kill the reader
         except (OSError, ValueError, struct.error, IndexError):
-            pass
+            pass  # includes failed AEAD tags: the connection tears down
         finally:
             with self._conn_lock:
-                if self._conns.get(remote_id) is sock:
+                if self._conns.get(remote_id) is conn:
                     del self._conns[remote_id]
-            try:
-                sock.close()
-            except OSError:
-                pass
+            conn.close()
 
     # -- sending -------------------------------------------------------------
 
     def send(self, src: str, dst: str, frame: tuple) -> None:
         with self._conn_lock:
-            sock = self._conns.get(dst)
+            conn = self._conns.get(dst)
             lock = self._send_locks.setdefault(dst, threading.Lock())
-        if sock is None:
+        if conn is None:
             return  # disconnected peer: frames drop, like an unreachable host
         try:
-            # sendall of a large frame is not atomic: concurrent writers
+            # send of a large frame is not atomic: concurrent writers
             # (RPC responder + gossip publisher) must not interleave bytes
-            # inside the length-prefixed stream.
+            # inside the length-prefixed stream — and the noise cipher's
+            # counter nonce additionally requires in-order encryption.
             with lock:
-                sock.sendall(_pack(("frame", src, frame)))
+                conn.send_msg(("frame", src, frame))
         except OSError:
+            # Socket-level failure: evict AND close (the reader's cleanup
+            # no-ops once the conn left the map).
             with self._conn_lock:
-                if self._conns.get(dst) is sock:
+                if self._conns.get(dst) is conn:
                     del self._conns[dst]
+            conn.close()
+        # ValueError (frame too large, raised before any byte is written)
+        # propagates: the stream is intact and the connection healthy.
 
     def connected_peers(self):
         with self._conn_lock:
@@ -344,13 +404,10 @@ class TcpTransport:
         except OSError:
             pass
         with self._conn_lock:
-            socks = list(self._conns.values())
+            conns = list(self._conns.values())
             self._conns.clear()
-        for s in socks:
-            try:
-                s.close()
-            except OSError:
-                pass
+        for c in conns:
+            c.close()
 
 
 # --- UDP discovery codec ----------------------------------------------------

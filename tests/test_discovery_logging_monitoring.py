@@ -16,18 +16,17 @@ from lighthouse_tpu.common.monitoring import MonitoringService, system_health
 from lighthouse_tpu.network.discovery import (
     BootNode,
     Discovery,
-    Enr,
+    make_node_enr,
     subnet_predicate,
 )
+from lighthouse_tpu.network.enr import Enr, EnrError, generate_key
 from lighthouse_tpu.network.gossip import SimTransport
 
 
 class _DiscNode:
     def __init__(self, pid, transport, attnets=0):
         self.peer_id = pid
-        self.discovery = Discovery(
-            Enr(peer_id=pid, attnets=attnets), transport
-        )
+        self.discovery = Discovery.create(pid, transport, attnets=attnets)
         transport.register(self)
 
     def handle_frame(self, src, frame):
@@ -65,14 +64,51 @@ def test_subnet_predicate_filters():
 
 def test_enr_seq_updates():
     t = SimTransport()
-    d = Discovery(Enr(peer_id="x"), t)
+    d = Discovery.create("x", t)
     seq0 = d.local_enr.seq
     d.update_local_enr(attnets=0b11)
     assert d.local_enr.seq == seq0 + 1
-    # stale records don't overwrite newer ones
-    d.add_enr(Enr(peer_id="y", seq=5, attnets=1))
-    d.add_enr(Enr(peer_id="y", seq=3, attnets=0))
-    assert d.records["y"].seq == 5 and d.records["y"].attnets == 1
+    assert d.local_enr.verify()                 # re-signed, still valid
+    assert d.local_enr.subscribed_to_attnet(0)
+    assert d.local_enr.subscribed_to_attnet(1)
+    # stale records don't overwrite newer ones (same key, lower seq)
+    ky = generate_key()
+    genuine = make_node_enr(ky, "y", attnets=1, seq=5)
+    d.add_enr(genuine)
+    d.add_enr(make_node_enr(ky, "y", attnets=0, seq=3))
+    rec = d.record_for_peer("y")
+    assert rec.seq == 5 and rec.attnets_int == 1
+    # A DIFFERENT key claiming the same pid with a huge seq gets its own
+    # node-id entry; it cannot evict or freeze out the genuine record.
+    d.add_enr(make_node_enr(generate_key(), "y", attnets=0, seq=2**31))
+    assert d.records[genuine.node_id].attnets_int == 1
+    d.add_enr(make_node_enr(ky, "y", attnets=3, seq=6))
+    assert d.records[genuine.node_id].seq == 6
+
+
+def test_enr_wire_is_eip778_and_rejects_tampering():
+    """Wire records are real EIP-778: spec example decodes + verifies;
+    a flipped byte is dropped at table admission."""
+    spec_enr = ("enr:-IS4QHCYrYZbAKWCBRlAy5zzaDZXJBGkcnh4MHcBFZntXNFrdvJjX0"
+                "4jRzjzCBOonrkTfj499SZuOh8R33Ls8RRcy5wBgmlkgnY0gmlwhH8AAAGJ"
+                "c2VjcDI1NmsxoQPKY0yuDUmstAHYpMa2_oxVtw0RW_QAdpzBQA8yWM0xOI"
+                "N1ZHCCdl8")
+    rec = Enr.from_text(spec_enr)
+    assert rec.verify() and rec.udp == 30303 and rec.ip == "127.0.0.1"
+    assert rec.node_id.hex() == (
+        "a448f24c6d18e575453db13171562b71999873db5b286df957af199ec94617f7")
+    assert rec.to_text() == spec_enr            # byte-exact re-encode
+
+    t = SimTransport()
+    d = Discovery.create("local", t)
+    good = make_node_enr(generate_key(), "peer", attnets=0b10)
+    raw = bytearray(good.to_rlp())
+    raw[-1] ^= 0x01
+    d.handle_frame("peer", ("disc_nodes", 1, [bytes(raw)]))
+    assert d.table_len() == 0                   # tampered record dropped
+    d.handle_frame("peer", ("disc_nodes", 1, [good.to_rlp()]))
+    assert d.table_len() == 1
+    assert d.record_for_peer("peer").subscribed_to_attnet(1)
 
 
 def test_logging_sinks(tmp_path):

@@ -251,3 +251,63 @@ def test_batched_attestation_path_via_processor():
     epoch = h2.spec.epoch_at_slot(slot)
     for v in committee:
         assert h2.chain.observed_attesters.is_known(epoch, v)
+
+
+def test_gossipsub_protobuf_rpc_roundtrip():
+    """Wire envelopes are the real gossipsub rpc.proto encoding."""
+    from lighthouse_tpu.network import pubsub_pb
+
+    rpc = {
+        "subscriptions": [(True, "/eth2/abcd/beacon_block/ssz_snappy"),
+                          (False, "/eth2/abcd/voluntary_exit/ssz_snappy")],
+        "publish": [{"topic": "t1", "data": b"\x01\x02"},
+                    {"topic": "t2", "data": b""}],
+        "control": {"ihave": [("t1", [b"m" * 20, b"n" * 20])],
+                    "iwant": [[b"m" * 20]],
+                    "graft": ["t1"],
+                    "prune": [("t2", 60)]},
+    }
+    enc = pubsub_pb.encode_rpc(rpc)
+    dec = pubsub_pb.decode_rpc(enc)
+    assert dec["subscriptions"] == rpc["subscriptions"]
+    assert [(m["topic"], m["data"]) for m in dec["publish"]] == \
+        [("t1", b"\x01\x02"), ("t2", b"")]
+    assert dec["control"]["ihave"] == rpc["control"]["ihave"]
+    assert dec["control"]["iwant"] == rpc["control"]["iwant"]
+    assert dec["control"]["graft"] == ["t1"]
+    assert dec["control"]["prune"] == [("t2", 60)]
+
+    # StrictNoSign: a Message with a signature field is flagged.
+    signed = pubsub_pb._ld(2, pubsub_pb._ld(4, b"t") + pubsub_pb._ld(5, b"sig"))
+    dec2 = pubsub_pb.decode_rpc(bytes(signed))
+    assert dec2["publish"][0].get("signed_fields") is True
+
+    # Malformed protobuf raises (sender gets penalized by the node).
+    import pytest as _pytest
+
+    with _pytest.raises(pubsub_pb.PbError):
+        pubsub_pb.decode_rpc(b"\x0a\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff")
+
+
+def test_gossipsub_ihave_iwant_heals_non_mesh_peer():
+    """Lazy gossip: a subscribed peer OUTSIDE the mesh learns message ids
+    via IHAVE on heartbeat and pulls the payload with IWANT."""
+    t = SimTransport()
+    a = GossipNode("ga", t)
+    b = GossipNode("gb", t)
+    got = []
+    a.subscribe("top")
+    b.subscribe("top", handler=lambda _t, d, _o: got.append(d))
+    t.connect(a, b)
+    # Publish while meshed (fills a's mcache), then simulate b having
+    # missed it: clear b's seen state and drop b from a's mesh.
+    a.publish("top", b"payload-1")
+    a.mesh["top"].discard("gb")
+    b._seen.clear()
+    got.clear()
+    # Lazy-gossip emission targets non-mesh subscribers (heartbeat would
+    # re-graft b first at this tiny swarm size, so emit directly)...
+    a._emit_gossip("top")
+    # ...which triggers b's IWANT pull and a's mcache serve, end to end
+    # through the transport.
+    assert got == [b"payload-1"]

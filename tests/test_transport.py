@@ -234,3 +234,78 @@ def test_three_process_testnet_finalizes():
             except Exception:
                 pass
             p.terminate()
+
+
+def test_noise_handshake_vectors_and_properties():
+    """Noise_XX_25519_ChaChaPoly_SHA256 state machine: both sides derive
+    the same handshake hash and opposite cipher pairs; payloads are
+    mutually authenticated; tampered transport ciphertext fails the tag."""
+    from lighthouse_tpu.network.noise import NoiseError, NoiseHandshake
+
+    ini = NoiseHandshake(initiator=True, payload=b"alice")
+    res = NoiseHandshake(initiator=False, payload=b"bob")
+    m1 = ini.write_message()
+    res.read_message(m1)
+    m2 = res.write_message()
+    ini.read_message(m2)
+    m3 = ini.write_message()
+    res.read_message(m3)
+    si, sr = ini.session(), res.session()
+    assert si.handshake_hash == sr.handshake_hash     # channel binding
+    assert si.remote_payload == b"bob"
+    assert sr.remote_payload == b"alice"
+    ct = si.encrypt(b"attestation bytes")
+    assert ct != b"attestation bytes" and len(ct) == len(b"attestation bytes") + 16
+    assert sr.decrypt(ct) == b"attestation bytes"
+    ct2 = sr.encrypt(b"reply")
+    assert si.decrypt(ct2) == b"reply"
+    bad = bytearray(si.encrypt(b"x"))
+    bad[0] ^= 1
+    try:
+        sr.decrypt(bytes(bad))
+        assert False, "tampered ciphertext must fail"
+    except NoiseError:
+        pass
+    # An eavesdropper with her own ephemeral cannot decrypt message 2's
+    # static key (her ee differs): the AEAD tag fails.
+    eve = NoiseHandshake(initiator=True, payload=b"eve")
+    eve.write_message()
+    try:
+        eve.read_message(m2)
+        assert False, "eavesdropper must not decrypt message 2"
+    except NoiseError:
+        pass
+
+
+def test_tcp_noise_encrypted_transport():
+    """Full TcpTransport with secure=True: frames flow over the encrypted
+    channel; a plaintext (insecure) dialer cannot connect; the hello id
+    is bound to the noise identity."""
+    ta, tb = TcpTransport(secure=True), TcpTransport(secure=True)
+    a, b = _Recorder("enc-a"), _Recorder("enc-b")
+    ta.register(a)
+    tb.register(b)
+    tc = TcpTransport()          # plaintext transport
+    c = _Recorder("plain-c")
+    tc.register(c)
+    try:
+        remote = ta.dial(tb.listen_addr)
+        assert remote == "enc-b"
+        ta.send("enc-a", "enc-b", ("gossip", b"\x01" * 64))
+        assert b.event.wait(5.0)
+        assert b.frames == [("enc-a", ("gossip", b"\x01" * 64))]
+        tb.send("enc-b", "enc-a", ("ack",))
+        assert a.event.wait(5.0)
+
+        # A plaintext dialer cannot join an encrypted listener: its hello
+        # is not a noise message 1 the responder accepts as a handshake,
+        # and the dial errors or times out without a connection forming.
+        import pytest as _pytest
+
+        with _pytest.raises((ConnectionError, OSError, ValueError)):
+            tc.dial(tb.listen_addr, timeout=2.0)
+        assert "plain-c" not in tb.connected_peers()
+    finally:
+        ta.close()
+        tb.close()
+        tc.close()
