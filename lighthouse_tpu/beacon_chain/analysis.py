@@ -81,12 +81,20 @@ def _pre_state(chain, block) -> object:
                             int(block.message.slot))
 
 
+def _canonical_block_at_or_before(chain, slot: int):
+    """Newest canonical block with block.slot <= slot — early-exit walk
+    from the head (O(head_slot - slot), not O(chain))."""
+    block = chain.store.get_block(chain.head.block_root)
+    while block is not None and int(block.message.slot) > slot:
+        block = chain.store.get_block(bytes(block.message.parent_root))
+    return block
+
+
 def _state_at_slot(chain, slot: int) -> object:
     """Canonical state at `slot` (post-block if a block sits there)."""
-    seg = canonical_blocks(chain, 0, slot)
-    if not seg:
+    block = _canonical_block_at_or_before(chain, slot)
+    if block is None:
         raise AnalysisError("no canonical block at or before slot")
-    _root, block = seg[-1]
     state = chain.store.get_state(bytes(block.message.state_root))
     if state is None:
         raise AnalysisError("state pruned")
@@ -181,6 +189,18 @@ def compute_block_rewards(chain, start_slot: int, end_slot: int) -> List[dict]:
             bp.process_attestation(state, t, spec, att, fork,
                                    VerifySignatures.FALSE, None)
         b3 = bal()
+        # Remaining operations in process_operations order — no proposer
+        # credit, but REQUIRED so the rolling state tracks the canonical
+        # chain (deposit index / registry / balances feed later blocks).
+        for dep in block.body.deposits:
+            bp.process_deposit(state, t, spec, dep, fork)
+        for exit_ in block.body.voluntary_exits:
+            bp.process_voluntary_exit(state, t, spec, exit_,
+                                      VerifySignatures.FALSE, None)
+        if ForkName.ge(fork, ForkName.CAPELLA):
+            for change in block.body.bls_to_execution_changes:
+                bp.process_bls_to_execution_change(
+                    state, t, spec, change, VerifySignatures.FALSE)
         sync_reward = 0
         if ForkName.ge(fork, ForkName.ALTAIR):
             # Analytic, not a balance diff: when the proposer is itself a

@@ -61,6 +61,11 @@ class ClientConfig:
     simulate_attestations: bool = False      # attestation_simulator.rs service
     kzg: object = None                       # Kzg trusted setup (deneb blobs)
     kzg_device: bool = False                 # batch KZG on the TPU backend
+    # Background device-shape warming (beacon_processor/warming.py): the
+    # bucket grid to compile at startup so the batch former can grow to
+    # production batches without mid-slot cold compiles. None = off
+    # (tests / CPU-only); the bn CLI enables the default grid.
+    warm_device_shapes: Optional[tuple] = None
 
 
 class Client:
@@ -84,6 +89,14 @@ class Client:
             )
 
             self.attestation_simulator = AttestationSimulator(chain)
+        self.shape_warmer = None
+        if config.warm_device_shapes:
+            from lighthouse_tpu.beacon_processor.warming import ShapeWarmer
+
+            self.shape_warmer = ShapeWarmer(
+                policy=processor.batch_policy,
+                shapes=config.warm_device_shapes,
+            )
 
     # ------------------------------------------------------------- lifecycle
 
@@ -92,6 +105,8 @@ class Client:
         self.processor.start()
         if self.api is not None:
             self.api.start()
+        if self.shape_warmer is not None:
+            self.shape_warmer.start()
         self._running = True
         self._timer = threading.Thread(target=self._slot_timer, daemon=True)
         self._timer.start()
@@ -102,6 +117,8 @@ class Client:
             return  # idempotent: the store closes once
         self._stopped = True
         self._running = False
+        if self.shape_warmer is not None:
+            self.shape_warmer.stop()
         self.processor.stop()
         if self.api is not None:
             self.api.stop()

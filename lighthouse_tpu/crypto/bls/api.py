@@ -102,7 +102,13 @@ class PublicKey:
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "PublicKey":
-        pt = c.g1_from_compressed(data)
+        try:
+            pt = c.g1_from_compressed(data)
+        except ValueError as e:
+            # Malformed wire bytes (bad length/flags, x >= p, not on
+            # curve) surface as BlsError — decode-path callers catch
+            # exactly that (default_pubkey_getter etc.).
+            raise BlsError(str(e))
         if pt is None:
             raise BlsError("infinity public key rejected")
         if not c.g1_in_subgroup(pt):
@@ -145,7 +151,10 @@ class Signature:
 
     @classmethod
     def from_bytes(cls, data: bytes, subgroup_check: bool = True) -> "Signature":
-        pt = c.g2_from_compressed(data)
+        try:
+            pt = c.g2_from_compressed(data)
+        except ValueError as e:
+            raise BlsError(str(e))   # malformed wire bytes (see PublicKey)
         if subgroup_check and pt is not None and not c.g2_in_subgroup(pt):
             raise BlsError("signature not in G2 subgroup")
         return cls(point=pt, subgroup_checked=subgroup_check)

@@ -222,35 +222,57 @@ class _Group:
     def mul_var_scalar(self, p, k, nbits: int = 64):
         """[k]p with a per-element scalar array (batched, e.g. the random
         64-bit batch-verification coefficients). ``k``: uint64, shape = batch
-        prefix of ``p``. MSB-first scan over ``nbits`` positions."""
-        positions = jnp.arange(nbits - 1, -1, -1, dtype=jnp.uint64)
+        prefix of ``p``.
+
+        2-bit windowed (VERDICT r2 #3): a {0, P, 2P, 3P} table costs two
+        batched group ops up front, then nbits/2 steps of two doublings and
+        ONE table add — 64 dbl + 32 add + 2, vs 64 + 64 for the bit scan
+        (~25% of the ladder). The table entry is picked with three
+        point-wide selects (VPU-cheap); digit 0 adds the infinity point,
+        absorbed by the complete RCB formulas."""
+        assert nbits % 2 == 0
+        p2 = self.double(p)
+        p3 = self.add(p2, p)
+        inf = jnp.broadcast_to(self.infinity, p.shape)
+        positions = jnp.arange(nbits - 2, -1, -2, dtype=jnp.uint64)
 
         def step(acc, pos):
-            acc = self.double(acc)
-            bit = (k >> pos) & jnp.uint64(1)
-            with_add = self.add(acc, p)
-            return self.select(bit == 1, with_add, acc), None
+            acc = self.double(self.double(acc))
+            digit = (k >> pos) & jnp.uint64(3)
+            entry = self.select(
+                digit == 1, p,
+                self.select(digit == 2, p2,
+                            self.select(digit == 3, p3, inf)),
+            )
+            return self.add(acc, entry), None
 
-        init = jnp.broadcast_to(self.infinity, p.shape)
-        acc, _ = jax.lax.scan(step, init, positions)
+        acc, _ = jax.lax.scan(step, inf, positions)
         return acc
 
     def mul_var_scalar_wide(self, p, k_words, nbits: int = 256):
         """[k]p with per-element MULTI-WORD scalars (KZG challenges span the
         full 255-bit Fr). ``k_words``: uint64 words little-endian, shape =
-        batch prefix of ``p`` + (ceil(nbits/64),)."""
-        positions = jnp.arange(nbits - 1, -1, -1, dtype=jnp.uint64)
+        batch prefix of ``p`` + (ceil(nbits/64),). Same 2-bit window as
+        mul_var_scalar (digits never straddle a word: 64 % 2 == 0)."""
+        assert nbits % 2 == 0
+        p2 = self.double(p)
+        p3 = self.add(p2, p)
+        inf = jnp.broadcast_to(self.infinity, p.shape)
+        positions = jnp.arange(nbits - 2, -1, -2, dtype=jnp.uint64)
 
         def step(acc, pos):
-            acc = self.double(acc)
+            acc = self.double(self.double(acc))
             word = jnp.take(k_words, (pos // jnp.uint64(64)).astype(jnp.int32),
                             axis=-1)
-            bit = (word >> (pos % jnp.uint64(64))) & jnp.uint64(1)
-            with_add = self.add(acc, p)
-            return self.select(bit == 1, with_add, acc), None
+            digit = (word >> (pos % jnp.uint64(64))) & jnp.uint64(3)
+            entry = self.select(
+                digit == 1, p,
+                self.select(digit == 2, p2,
+                            self.select(digit == 3, p3, inf)),
+            )
+            return self.add(acc, entry), None
 
-        init = jnp.broadcast_to(self.infinity, p.shape)
-        acc, _ = jax.lax.scan(step, init, positions)
+        acc, _ = jax.lax.scan(step, inf, positions)
         return acc
 
     def msm_reduce(self, pts, axis_size: int):

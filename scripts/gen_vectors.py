@@ -989,9 +989,185 @@ def main():
     gen_consensus()
     gen_round3()
     gen_round3_volume()
+    gen_round3c()
     gen_ssz_defaults()
     n = sum(len(files) for _, _, files in os.walk(VECTOR_ROOT))
     print(f"wrote {n} vector files under {VECTOR_ROOT}")
+
+
+
+
+def gen_round3c():
+    """Second round-3 breadth pass: per-operation NEGATIVE cases with
+    a-priori-known outcomes (rejections that fire before any signature
+    check, so they are implementation-independent), more shuffling
+    known-answer mappings, and extra epoch-processing states (leak and
+    slashing-queue shapes)."""
+    from lighthouse_tpu.state_transition import slot_processing as sp
+    from lighthouse_tpu.state_transition.helpers import (
+        compute_shuffled_index,
+    )
+    from lighthouse_tpu.testing.harness import BeaconChainHarness
+    from lighthouse_tpu.types.spec import minimal_spec
+
+    spec = minimal_spec()
+    fork = "capella"
+    h = BeaconChainHarness(n_validators=16, genesis_time=1_850_000_000)
+    types = h.types
+    scls = types.BeaconState[fork]
+    h.extend_chain(spec.preset.SLOTS_PER_EPOCH + 2, attest=True)
+    state = h.chain.head.state.copy()
+    state = sp.process_slots(state, types, spec, state.slot + 1)
+    pre = scls.serialize(state)
+
+    def negative(op_name, case, obj, cls):
+        d = case_dir("minimal", fork, "operations", op_name, "suite", case)
+        write_ssz(d, "pre.ssz", pre)
+        write_ssz(d, f"{op_name}.ssz", cls.serialize(obj))
+        write_meta(d, {"valid": False})
+
+    # --- attestation negatives -------------------------------------------
+    atts = h.make_attestations(state.slot - 1)
+    base = atts[0]
+    fut = base.copy()
+    fut.data = base.data.copy()
+    fut.data.slot = state.slot + 10          # future slot: reject
+    negative("attestation", "future_slot", fut, types.Attestation)
+    badidx = base.copy()
+    badidx.data = base.data.copy()
+    badidx.data.index = 63                   # committee index out of range
+    negative("attestation", "committee_index_oob", badidx, types.Attestation)
+    badtgt = base.copy()
+    badtgt.data = base.data.copy()
+    badtgt.data.target = base.data.target.copy()
+    badtgt.data.target.epoch = spec.epoch_at_slot(base.data.slot) + 1
+    negative("attestation", "target_epoch_mismatch", badtgt,
+             types.Attestation)
+
+    # --- voluntary_exit negatives (reject before signature checks) -------
+    negative("voluntary_exit", "index_out_of_range",
+             types.SignedVoluntaryExit(
+                 message=types.VoluntaryExit(epoch=0, validator_index=255),
+                 signature=b"\x00" * 96),
+             types.SignedVoluntaryExit)
+    future_epoch = types.VoluntaryExit(
+        epoch=spec.epoch_at_slot(state.slot) + 100, validator_index=2)
+    negative("voluntary_exit", "future_epoch",
+             types.SignedVoluntaryExit(message=future_epoch,
+                                       signature=b"\x00" * 96),
+             types.SignedVoluntaryExit)
+
+    # --- proposer_slashing negatives -------------------------------------
+    hdr = state.latest_block_header.copy()
+    hdr.state_root = scls.hash_tree_root(state)
+    signed_hdr = types.SignedBeaconBlockHeader(
+        message=hdr, signature=b"\x00" * 96)
+    identical = types.ProposerSlashing(
+        signed_header_1=signed_hdr, signed_header_2=signed_hdr)
+    negative("proposer_slashing", "identical_headers", identical,
+             types.ProposerSlashing)
+    h2 = hdr.copy()
+    h2.slot = hdr.slot + 1                   # different slots: not slashable
+    mismatch = types.ProposerSlashing(
+        signed_header_1=signed_hdr,
+        signed_header_2=types.SignedBeaconBlockHeader(
+            message=h2, signature=b"\x00" * 96),
+    )
+    negative("proposer_slashing", "different_slots", mismatch,
+             types.ProposerSlashing)
+
+    # --- attester_slashing negatives -------------------------------------
+    ia = types.IndexedAttestation(
+        attesting_indices=[1, 2, 3], data=base.data,
+        signature=bytes(base.signature),
+    )
+    not_slashable = types.AttesterSlashing(attestation_1=ia,
+                                           attestation_2=ia)
+    negative("attester_slashing", "same_data_not_slashable", not_slashable,
+             types.AttesterSlashing)
+    unsorted = types.IndexedAttestation(
+        attesting_indices=[3, 1, 2], data=base.data,
+        signature=bytes(base.signature),
+    )
+    other = base.copy()
+    other.data = base.data.copy()
+    other.data.beacon_block_root = b"\x11" * 32
+    ib = types.IndexedAttestation(
+        attesting_indices=[3, 1, 2], data=other.data,
+        signature=bytes(base.signature),
+    )
+    negative("attester_slashing", "indices_unsorted",
+             types.AttesterSlashing(attestation_1=unsorted,
+                                    attestation_2=ib),
+             types.AttesterSlashing)
+
+    # --- bls_to_execution_change negatives -------------------------------
+    change = types.BLSToExecutionChange(
+        validator_index=1,
+        from_bls_pubkey=bytes(state.validators[1].pubkey),
+        to_execution_address=b"\x22" * 20,
+    )
+    signed_change = types.SignedBLSToExecutionChange(
+        message=change, signature=b"\x00" * 96)
+    wrong_pk = types.BLSToExecutionChange(
+        validator_index=1,
+        from_bls_pubkey=bytes(state.validators[2].pubkey),  # hash mismatch
+        to_execution_address=b"\x22" * 20,
+    )
+    negative("bls_to_execution_change", "pubkey_hash_mismatch",
+             types.SignedBLSToExecutionChange(message=wrong_pk,
+                                              signature=b"\x00" * 96),
+             types.SignedBLSToExecutionChange)
+
+    # --- shuffling known-answer mappings ---------------------------------
+    for i, (seed_byte, count) in enumerate(
+            [(0x21, 17), (0x42, 64), (0x77, 100), (0xAB, 333)]):
+        seed = bytes([seed_byte]) * 32
+        rounds = spec.preset.SHUFFLE_ROUND_COUNT
+        mapping = [compute_shuffled_index(j, count, seed, rounds)
+                   for j in range(count)]
+        d = case_dir("minimal", fork, "shuffling", "core", "suite",
+                     f"map_{count}_{seed_byte:02x}")
+        write_meta(d, {"seed": hx(seed), "count": count, "rounds": rounds,
+                       "mapping": mapping})
+
+    # --- epoch_processing extra states -----------------------------------
+    def write_epoch(name, st):
+        d = case_dir("minimal", fork, "epoch_processing", "full", "suite",
+                     name)
+        write_ssz(d, "pre.ssz", scls.serialize(st))
+        post = st.copy()
+        post = sp.process_slots(
+            post, types, spec,
+            spec.start_slot_of_epoch(spec.epoch_at_slot(st.slot) + 1),
+        )
+        write_ssz(d, "post.ssz", scls.serialize(post))
+        write_meta(d, {})
+
+    leak = state.copy()
+    # Finality stalled long enough for the inactivity leak.
+    leak.finalized_checkpoint = leak.finalized_checkpoint.copy()
+    leak.finalized_checkpoint.epoch = 0
+    for i in range(len(leak.inactivity_scores)):
+        leak.inactivity_scores[i] = 8
+    write_epoch("inactivity_leak_scores", leak)
+
+    slashq = state.copy()
+    slashq.validators[4].slashed = True
+    slashq.validators[4].withdrawable_epoch = (
+        spec.epoch_at_slot(slashq.slot)
+        + spec.preset.EPOCHS_PER_SLASHINGS_VECTOR // 2
+    )
+    slashq.slashings[0] = 32 * 10**9
+    write_epoch("pending_slashing_penalty", slashq)
+
+    exiting = state.copy()
+    exiting.validators[5].exit_epoch = spec.epoch_at_slot(exiting.slot) + 1
+    exiting.validators[5].withdrawable_epoch = (
+        exiting.validators[5].exit_epoch
+        + spec.min_validator_withdrawability_delay
+    )
+    write_epoch("validator_exiting", exiting)
 
 
 if __name__ == "__main__":
