@@ -735,3 +735,30 @@ def pow_fixed(a, exponent: int):
 def inv(a):
     """a^-1 via Fermat (fixed exponent p-2); maps 0 to 0."""
     return pow_fixed(a, P - 2)
+
+
+def batch_inv(x):
+    """Invert every row of (n, L) with ONE Fermat ladder (round 3,
+    NOTES lever #5): inclusive prefix/suffix product scans (log-depth
+    associative_scan, ~4n multiplies total), a single-element p-2
+    exponentiation of the total, and inv(x_i) = prefix_{i-1} *
+    suffix_{i+1} * inv(total). Replaces a 381-sqr + ~95-mul ladder over
+    the whole batch with ~6 batched multiplies — the sequential step
+    count is unchanged (the single-element ladder is as deep as the
+    batched one) but the arithmetic volume drops ~80x.
+
+    ZERO CAVEAT, by contract: rows must be nonzero. A zero row poisons
+    the shared product and maps EVERY row to 0 (Fermat's per-element
+    0 -> 0 becomes all -> 0). Callers on possibly-zero inputs
+    (to_affine's Z of infinity points) substitute 1 under a mask first.
+    """
+    n = x.shape[0]
+    if n == 1:
+        return inv(x)
+    pre = jax.lax.associative_scan(mul, x, axis=0)
+    suf = jax.lax.associative_scan(mul, x, axis=0, reverse=True)
+    t = inv(pre[-1:])
+    one = jnp.broadcast_to(ONE_MONT, (1, x.shape[-1]))
+    left = jnp.concatenate([one, pre[:-1]], axis=0)
+    right = jnp.concatenate([suf[1:], one], axis=0)
+    return mul(mul(left, right), t)
