@@ -18,11 +18,14 @@ Differences from the reference, deliberately TPU-first:
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Deque, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
 
 # Queue capacities (lib.rs:83-196 envelope).
 QUEUE_CAPS = {
@@ -66,7 +69,12 @@ PRIORITY = [
 ]
 
 DEFAULT_MAX_BATCH = 64  # lib.rs:215-216
-BATCHABLE = {"gossip_attestation", "gossip_aggregate"}
+# The reference batches only attestations/aggregates (lib.rs:205-216 —
+# CPU batches amortize poorly); the device backend amortizes every
+# 1-key set family, so sync messages and BLS-to-execution changes (the
+# Capella-storm shapes, eval config #5) batch too.
+BATCHABLE = {"gossip_attestation", "gossip_aggregate",
+             "gossip_sync_signature", "gossip_bls_to_execution_change"}
 
 
 class AdaptiveBatchPolicy:
@@ -83,20 +91,29 @@ class AdaptiveBatchPolicy:
 
     def __init__(self, max_bucket: int = 4096, warm=(64,)):
         self.max_bucket = max_bucket
+        self._lock = threading.Lock()
         self.warm = set(warm)
+        # Running max mirrored into a plain int: read by the processor
+        # thread while the ShapeWarmer daemon mutates `warm` (a bare
+        # max(self.warm) could observe "Set changed size during
+        # iteration"; int loads are atomic in CPython).
+        self._warm_max = max(self.warm, default=1)
 
     def batch_limit(self, depth: int) -> int:
         if depth < 2:
             return 1
         b = 1 << (depth.bit_length() - 1)          # largest pow2 <= depth
         b = min(b, self.max_bucket)
-        growth_cap = 2 * max(self.warm, default=1)
+        growth_cap = 2 * self._warm_max
         return max(2, min(b, growth_cap))
 
     def note_ran(self, n: int) -> None:
         if n >= 2:
             bucket = 1 << ((n - 1).bit_length())   # shape the backend pads to
-            self.warm.add(min(bucket, self.max_bucket))
+            bucket = min(bucket, self.max_bucket)
+            with self._lock:
+                self.warm.add(bucket)
+                self._warm_max = max(self._warm_max, bucket)
 
 
 @dataclass
@@ -176,9 +193,12 @@ class BeaconProcessor:
         if len(work) > 1:
             self.stats.batches += 1
             self.stats.batched_items += len(work)
-            if self.batch_policy is not None:
-                self.batch_policy.note_ran(len(work))
             batch_fn = work[0].process_batch
+            if self.batch_policy is not None and batch_fn is not None:
+                # Only a REAL device batch warms a bucket shape: a kind
+                # drained per-item must not raise the growth cap to an
+                # uncompiled shape (mid-slot cold-compile hazard).
+                self.batch_policy.note_ran(len(work))
             if batch_fn is not None:
                 batch_fn([w.item for w in work])
             else:
@@ -221,6 +241,15 @@ class BeaconProcessor:
 
     def _loop(self) -> None:
         while self._running:
-            if not self.step():
+            try:
+                idle = not self.step()
+            except Exception:  # noqa: BLE001 — a failed work item must not
+                # kill the manager thread (the node would silently stop
+                # importing gossip work); the item is already popped, so
+                # log-and-continue matches the reference's per-task
+                # error isolation.
+                logger.exception("beacon processor work item failed")
+                idle = False
+            if idle:
                 with self._lock:
                     self._work_ready.wait(timeout=0.05)

@@ -28,6 +28,10 @@ import numpy as np
 
 DEFAULT_SHAPE_GRID: Tuple[Tuple[int, int], ...] = (
     (64, 1), (64, 4), (256, 1), (256, 4), (1024, 1), (1024, 4),
+    # Round-4: the scaling probe peaks at n=2048 (NOTES_TPU_PERF.md);
+    # warming it lets the AdaptiveBatchPolicy's growth cap reach the
+    # peak-throughput bucket during a gossip storm.
+    (2048, 1), (2048, 4),
 )
 
 

@@ -45,10 +45,11 @@ from . import limbs as lb
 from . import pairing as pr
 from . import tower as tw
 
-# -g1 generator, staged once (the constant pair of the batch equation).
-_NEG_G1_AFF = lb.ints_to_mont(
-    [(_oc.G1_GEN[0]), (_P - _oc.G1_GEN[1])]
-).reshape(2, lb.L)
+# -g1 generator, staged once (the constant pair of the batch equation),
+# projective with Z = 1 (the Miller loop is projective since round 4).
+_NEG_G1_PROJ = lb.ints_to_mont(
+    [(_oc.G1_GEN[0]), (_P - _oc.G1_GEN[1]), 1]
+).reshape(3, lb.L)
 
 
 def _next_pow2(n: int, floor: int = 1) -> int:
@@ -71,7 +72,11 @@ def _prepare_pairs(pk_proj, sig_proj, sig_checked, set_mask, scalars):
     sig_checked: (n,) bool       host-side subgroup-check amortization flag
     set_mask:    (n,) bool       True for real sets
     scalars:     (n,) uint64     nonzero random batch coefficients
-    -> (p_aff (n+1,2,L), s_aff (2,2,L), sets_valid ())
+    -> (p_proj (n+1,3,L), s_proj (3,2,L), sets_valid ())
+
+    Round 4: outputs stay PROJECTIVE — the Miller loop homogenizes its
+    lines, so the to_affine inversion ladders (381 squarings each) that
+    used to close this stage are gone.
     """
     n = pk_proj.shape[0]
     # Aggregate pubkeys per set: tree over the K axis (complete adds absorb
@@ -90,21 +95,20 @@ def _prepare_pairs(pk_proj, sig_proj, sig_checked, set_mask, scalars):
     rsig = cv.G2.mul_var_scalar(sig_proj, scalars)                # (n, 3, 2, L)
     s_proj = lb.tree_reduce(rsig, cv.G2.add, cv.G2.infinity, n)   # (3, 2, L)
 
-    p_aff = jnp.concatenate(
-        [pr.to_affine_g1(a_proj), jnp.broadcast_to(_NEG_G1_AFF, (1, 2, lb.L))]
+    p_proj = jnp.concatenate(
+        [a_proj, jnp.broadcast_to(_NEG_G1_PROJ, (1, 3, lb.L))]
     )
-    s_aff = pr.to_affine_g2(s_proj)
     sets_valid = jnp.all(
         jnp.where(set_mask, jnp.logical_and(sig_ok, ~agg_inf), True)
     )
-    return p_aff, s_aff, sets_valid
+    return p_proj, s_proj, sets_valid
 
 
-def _pairing_check(p_aff, h_proj, s_aff, set_mask, sets_valid):
-    """Final product-of-pairings check (stage 3)."""
-    q_aff = jnp.concatenate([pr.to_affine_g2(h_proj), s_aff[None]])
+def _pairing_check(p_proj, h_proj, s_proj, set_mask, sets_valid):
+    """Final product-of-pairings check (stage 3, all-projective)."""
+    q_proj = jnp.concatenate([h_proj, s_proj[None]])
     mask = jnp.concatenate([set_mask, jnp.ones((1,), dtype=bool)])
-    pairing_ok = pr.multi_pairing_is_one(p_aff, q_aff, mask)
+    pairing_ok = pr.multi_pairing_is_one_proj(p_proj, q_proj, mask)
     return jnp.logical_and(pairing_ok, sets_valid)
 
 
@@ -115,10 +119,10 @@ def _verify_core(u, pk_proj, sig_proj, sig_checked, set_mask, scalars):
     persistent cache, and the staged split costs nothing: arrays never
     leave the device between stages)."""
     h_proj = h2c.hash_to_g2_device(u)                             # (n, 3, 2, L)
-    p_aff, s_aff, sets_valid = _prepare_pairs(
+    p_proj, s_proj, sets_valid = _prepare_pairs(
         pk_proj, sig_proj, sig_checked, set_mask, scalars
     )
-    return _pairing_check(p_aff, h_proj, s_aff, set_mask, sets_valid)
+    return _pairing_check(p_proj, h_proj, s_proj, set_mask, sets_valid)
 
 
 @lru_cache(maxsize=None)
@@ -134,14 +138,15 @@ def _jitted_core(n_bucket: int, k_bucket: int, sharded: bool,
 
         def core(u, pk_proj, sig_proj, sig_checked, set_mask, scalars):
             h_proj = stage1(u)
-            p_aff, s_aff, sets_valid = stage2(
+            p_proj, s_proj, sets_valid = stage2(
                 pk_proj, sig_proj, sig_checked, set_mask, scalars
             )
-            return stage3(p_aff, h_proj, s_aff, set_mask, sets_valid)
+            return stage3(p_proj, h_proj, s_proj, set_mask, sets_valid)
 
         return core
 
     from lighthouse_tpu.parallel import mesh as pm
+    from . import fused
 
     def constrained(fn):
         def wrapped(*args):
@@ -151,19 +156,28 @@ def _jitted_core(n_bucket: int, k_bucket: int, sharded: bool,
                 if hasattr(x, "ndim") and x.ndim >= 1 else x
                 for x in args
             ]
-            return fn(*args)
+            # Pallas kernels do not partition under the mesh — trace the
+            # sharded graph with the XLA fallback (fused.disabled()).
+            with fused.disabled():
+                return fn(*args)
+        return wrapped
+
+    def unfused(fn):
+        def wrapped(*args):
+            with fused.disabled():
+                return fn(*args)
         return wrapped
 
     stage1 = jax.jit(constrained(h2c.hash_to_g2_device))
     stage2 = jax.jit(constrained(_prepare_pairs))
-    stage3 = jax.jit(_pairing_check)  # (n+1) axis: leave layout to XLA
+    stage3 = jax.jit(unfused(_pairing_check))  # (n+1): leave layout to XLA
 
     def core(u, pk_proj, sig_proj, sig_checked, set_mask, scalars):
         h_proj = stage1(u)
-        p_aff, s_aff, sets_valid = stage2(
+        p_proj, s_proj, sets_valid = stage2(
             pk_proj, sig_proj, sig_checked, set_mask, scalars
         )
-        return stage3(p_aff, h_proj, s_aff, set_mask, sets_valid)
+        return stage3(p_proj, h_proj, s_proj, set_mask, sets_valid)
 
     return core
 
@@ -171,6 +185,17 @@ def _jitted_core(n_bucket: int, k_bucket: int, sharded: bool,
 # ---------------------------------------------------------------------------
 # Host staging
 # ---------------------------------------------------------------------------
+
+
+def verify_signature_sets_tpu_async(
+    sets: Sequence["_api.SignatureSet"], sharded: Optional[bool] = None
+):
+    """Dispatch the device check WITHOUT blocking: returns a () bool jax
+    array (or a python bool for host-side early-outs / the small-batch
+    native fallback). The staging for the NEXT batch overlaps the device
+    execution of this one — the double-buffering lever of NOTES #2;
+    bench.py and the beacon processor's staging worker drive it."""
+    return _verify_tpu_impl(sets, sharded)
 
 
 def verify_signature_sets_tpu(
@@ -182,6 +207,10 @@ def verify_signature_sets_tpu(
     (api.verify_signature_sets_oracle): empty batch, empty signing_keys,
     infinity signature.
     """
+    return bool(_verify_tpu_impl(sets, sharded))
+
+
+def _verify_tpu_impl(sets, sharded):
     sets = list(sets)
     if not sets:
         return False
@@ -246,7 +275,10 @@ def verify_signature_sets_tpu(
         scalars[i] = r
 
     core = _jitted_core(n_bucket, k_bucket, bool(sharded))
-    out = core(
+    # Returned WITHOUT bool(): async dispatch — callers that need the
+    # answer now take bool() (verify_signature_sets_tpu); pipelining
+    # callers keep staging the next batch first.
+    return core(
         jnp.asarray(u),
         pk_proj,
         sig_proj,
@@ -254,7 +286,6 @@ def verify_signature_sets_tpu(
         jnp.asarray(set_mask),
         jnp.asarray(scalars),
     )
-    return bool(out)
 
 
 # Register with the API seam (mirrors define_mod! backend instantiation,

@@ -216,10 +216,9 @@ def _fold_dot(hi, nrows: int):
 _SQ_BIAS = 17.0
 _E_WIN = sum(1 << (B * i) for i in range(W_IN))      # all-ones digit value
 _OFFSET_K = (int(_SQ_BIAS) * _E_WIN + (1 << 392)) // P + 1
-_OFFSET_SQ = jnp.asarray(
-    int_to_limbs(_OFFSET_K * P - int(_SQ_BIAS) * _E_WIN, width=W_IN),
-    dtype=DTYPE,
-)
+_OFFSET_SQ_NP = int_to_limbs(_OFFSET_K * P - int(_SQ_BIAS) * _E_WIN,
+                             width=W_IN)
+_OFFSET_SQ = jnp.asarray(_OFFSET_SQ_NP, dtype=DTYPE)
 
 
 def _squeeze(x):
@@ -251,6 +250,33 @@ def _fold_small(x, nrows: int):
     for j in range(nrows):
         out = out + x[..., L + j, None] * _T_FOLD[j]
     return out
+
+
+def _reduce_light(x):
+    """Round-4 cheap reduction for values that feed (almost) straight
+    into another multiply: ~40% fewer elementwise passes than _reduce by
+    NOT pinning the value under 2^384.
+
+    Rounds: passes(3) + big fold (as _reduce: value < 2^398.8, digits
+    f32-exact), then TWO [pad, passes(2), fold_small(3)] rounds
+    (2^398.8 -> 2^395 -> 2^391), then passes(2) and a CLOSING
+    fold_small(3) instead of a truncation — the standard reduce may
+    truncate at L columns only because its value is < 2^384; here the
+    carries landing in columns 48..50 still carry value, so they are
+    folded back mod p. Output: digits <= 258 + 3*258*255 < 2^17.6
+    (within the module's |digit| <= 2^20 contract) and value
+    < 2^384 + 0.12*2^391 < 2^388.4 — THREE lazy add/sub levels of
+    headroom against the 2^392 squeeze bound. Callers: the Fp12-level
+    tower outputs (tower._out4_light), whose consumers are the next
+    Fp12 multiply, selects, conjugation, or a single sub (fp12_eq)."""
+    w = x.shape[-1]
+    x = _passes(_pad_cols(x, w + 3), 3)
+    x = x[..., :L] + _fold_dot(x[..., L:], x.shape[-1] - L)
+    for _ in range(2):
+        x = _passes(_pad_cols(x, L + 3), 2)
+        x = _fold_small(x, 3)
+    x = _passes(_pad_cols(x, L + 3), 2)
+    return _fold_small(x, 3)
 
 
 def _reduce(x, folds: int = 5):
@@ -349,9 +375,11 @@ class _NttPlan:
                     W[k, i] = center(q[i] * scale % p, p)
             w_blocks.append(W)
 
-        self.v_all = jnp.asarray(
-            np.concatenate(v_blocks, axis=1), dtype=jnp.bfloat16
-        )                                                   # (W_IN, n_p*N)
+        # Host (numpy) copies kept for the Pallas kernels (ops/fused.py):
+        # trace-time literals, so the fused kernels need no extra operands.
+        self.v_all_np = np.concatenate(v_blocks, axis=1)    # (W_IN, n_p*N)
+        self.w_np = np.stack(w_blocks)                      # (n_p, N, N)
+        self.v_all = jnp.asarray(self.v_all_np, dtype=jnp.bfloat16)
         # Per-prime inverse matrices (plain dots: XLA:CPU's thunk runtime
         # has no BATCHED bf16 dot, and n_p separate MXU matmuls schedule
         # just as well on TPU).
@@ -488,7 +516,11 @@ def ntt_inv_cols(prod, plan=_PLAN3):
 
 def ntt_fwd_lazy(x, plan=_PLAN3):
     """Lazy limb element(s) (..., L) -> centered domain residues
-    (..., n_p, NCOLS): squeeze + forward evaluation."""
+    (..., n_p, NCOLS): squeeze + forward evaluation (Pallas-fused on TPU,
+    ops/fused.py)."""
+    from . import fused
+    if fused.enabled():
+        return fused.squeeze_fwd(x, plan)
     return ntt_fwd(_squeeze(x), plan)
 
 
@@ -514,7 +546,7 @@ def _build_offset_dom(plan, shift_bits: int):
                 xp = xp * point % p
             c = acc if acc <= p // 2 else acc - p
             arr[j, point] = float(c)
-    return jnp.asarray(arr, dtype=DTYPE)
+    return arr
 
 
 # Offsets sized to the tower's schoolbook combination bounds (tower.py):
@@ -522,29 +554,52 @@ def _build_offset_dom(plan, shift_bits: int):
 #     the negative side and 2^22 + 2*3.34M + p < M3.
 #   plan4 (fp6/fp12 mul): worst column magnitude ~81 * 51*256^2 < 2.8e8;
 #     2^29 dominates and 2^29 + 2.8e8 + p-part < M4 = 3.37e9.
+_OFFSET_DOM3_NP = None
+_OFFSET_DOM4_NP = None
 _OFFSET_DOM3 = None
 _OFFSET_DOM4 = None
+
+
+def offset_dom3_np() -> np.ndarray:
+    global _OFFSET_DOM3_NP
+    if _OFFSET_DOM3_NP is None:
+        _OFFSET_DOM3_NP = _build_offset_dom(_PLAN3, 22)
+    return _OFFSET_DOM3_NP
+
+
+def offset_dom4_np() -> np.ndarray:
+    global _OFFSET_DOM4_NP
+    if _OFFSET_DOM4_NP is None:
+        _OFFSET_DOM4_NP = _build_offset_dom(plan4(), 29)
+    return _OFFSET_DOM4_NP
 
 
 def offset_dom3():
     global _OFFSET_DOM3
     if _OFFSET_DOM3 is None:
-        _OFFSET_DOM3 = _build_offset_dom(_PLAN3, 22)
+        _OFFSET_DOM3 = jnp.asarray(offset_dom3_np(), dtype=DTYPE)
     return _OFFSET_DOM3
 
 
 def offset_dom4():
     global _OFFSET_DOM4
     if _OFFSET_DOM4 is None:
-        _OFFSET_DOM4 = _build_offset_dom(plan4(), 29)
+        _OFFSET_DOM4 = jnp.asarray(offset_dom4_np(), dtype=DTYPE)
     return _OFFSET_DOM4
 
 
-def ntt_dom_to_limbs(c, plan, offset_dom):
+def ntt_dom_to_limbs(c, plan, offset_dom, light: bool = False):
     """Signed domain combination -> loose-canonical limbs (..., L): add
-    the non-negativity offset, center, interpolate, reduce. The caller
-    guarantees its combination's true columns + offset lie in [0, M)."""
-    return _reduce(ntt_inv_cols(ntt_center(c + offset_dom, plan), plan))
+    the non-negativity offset, center, interpolate, reduce (Pallas-fused
+    on TPU, ops/fused.py). The caller guarantees its combination's true
+    columns + offset lie in [0, M). `light` uses _reduce_light — only
+    for outputs whose consumers tolerate its looser value bound (see its
+    docstring; the Fp12 tower ops)."""
+    from . import fused
+    if fused.enabled():
+        return fused.inv_out(c, plan, with_offset=True)
+    cols = ntt_inv_cols(ntt_center(c + offset_dom, plan), plan)
+    return _reduce_light(cols) if light else _reduce(cols)
 
 
 # --- Core multiply --------------------------------------------------------------
@@ -566,6 +621,11 @@ def mul(a, b):
     """Field multiply (plain representation): value(out) == a*b mod p.
     Accepts lazy inputs (contract at module top); output loose-canonical."""
     a, b = jnp.broadcast_arrays(a, b)
+    from . import fused
+    if fused.enabled() and _ENGINE != "schoolbook":
+        fa = fused.squeeze_fwd(a, _PLAN3)
+        fb = fused.squeeze_fwd(b, _PLAN3)
+        return fused.inv_out(fa * fb, _PLAN3, with_offset=False)
     na = _squeeze(a)
     nb = _squeeze(b)
     if _ENGINE == "schoolbook":
@@ -578,6 +638,10 @@ def mul(a, b):
 def sqr(a):
     """Squaring: one squeeze/forward instead of two (the product reuses
     the normalized operand)."""
+    from . import fused
+    if fused.enabled() and _ENGINE != "schoolbook":
+        fa = fused.squeeze_fwd(a, _PLAN3)
+        return fused.inv_out(fa * fa, _PLAN3, with_offset=False)
     na = _squeeze(a)
     if _ENGINE == "schoolbook":
         return _reduce(_col_product(na, na))

@@ -58,14 +58,20 @@ _TAIL_DBLS = _count
 # ---------------------------------------------------------------------------
 
 
-def _dbl_step(t, px, py):
+def _dbl_step(t, px, py, pz):
     """Fused doubling step: 2T (RCB complete doubling) and the line at 2T
-    through T evaluated at P, sharing every subproduct — 15 Fp2 muls in
+    through T evaluated at P, sharing every subproduct — 16 Fp2 muls in
     three batched calls.
 
-    Affine line xi*py + (l.xt - yt) w^3 - l.px w^5 scaled by 2*Y*Z^2:
-        l0 = xi * (2 Y Z^2) * py
+    P is PROJECTIVE (px, py, pz) — the affine line
+        l0 = xi * (2 Y Z^2) * (py/pz)
         l1 = 3 X^3 - 2 Y^2 Z
+        l2 = -(3 X^2 Z) * (px/pz)
+    is homogenized by the Fp factor pz (subfield scalings die in the
+    final exponentiation — the full exponent is divisible by p^2 - 1),
+    which removes the prepare-stage to_affine inversion ladders entirely
+    (round 4; NOTES lever #5):
+        l0 = xi * (2 Y Z^2) * py ; l1 = (3 X^3 - 2 Y^2 Z) * pz ;
         l2 = -(3 X^2 Z) * px
     """
     X, Y, Z = cv.G2.coords(t)
@@ -93,48 +99,52 @@ def _dbl_step(t, px, py):
 
     t_next = cv.G2.pack(lb.add(q3, q3), lb.add(q0, q2), q1)
 
-    l1 = lb.sub(cv.FP2.mul_small(X3c, 3), lb.add(Y2Z, Y2Z))
+    l1_raw = lb.sub(cv.FP2.mul_small(X3c, 3), lb.add(Y2Z, Y2Z))
     two_yz2 = lb.add(YZ2, YZ2)
     scaled = tw.fp2_mul_fp(
-        jnp.stack([tw.fp2_mul_by_xi(two_yz2), cv.FP2.mul_small(X2Z, 3)], axis=-3),
-        jnp.stack([py, px], axis=-2),
+        jnp.stack([tw.fp2_mul_by_xi(two_yz2), cv.FP2.mul_small(X2Z, 3),
+                   l1_raw], axis=-3),
+        jnp.stack([py, px, pz], axis=-2),
     )
     l0 = scaled[..., 0, :, :]
     l2 = lb.neg(scaled[..., 1, :, :])
+    l1 = scaled[..., 2, :, :]
     return t_next, (l0, l1, l2)
 
 
-def _add_step(t, q, px, py):
-    """Addition step: (T + Q, line through T and Q at P). Q affine (xq, yq).
+def _add_step(t, q, px, py, pz):
+    """Addition step: (T + Q, line through T and Q at P). Q PROJECTIVE
+    (xq, yq, zq) and P PROJECTIVE (px, py, pz).
 
-    Slope l = n/d with n = yq Z1 - Y1, d = xq Z1 - X1; line scaled by d*Z1:
+    Affine slope l = n/d with n = yq/zq - Y1/Z1, d = xq/zq - X1/Z1;
+    both are scaled by Z1*zq (n = yq Z1 - Y1 zq, d = xq Z1 - X1 zq) —
+    a uniform zq factor on the line, which the final exponentiation
+    kills along with the d*Z1 scaling and the pz homogenization:
         l0 = xi * (d Z1) * py
-        l1 = n X1 - d Y1
+        l1 = (n X1 - d Y1) * pz
         l2 = -(n Z1) * px
     """
     X1, Y1, Z1 = cv.G2.coords(t)
-    xq = q[..., 0, :, :]
-    yq = q[..., 1, :, :]
+    xq, yq, zq = cv.G2.coords(q)
     m1 = tw.fp2_mul(
-        jnp.stack([yq, xq], axis=-3),
-        jnp.stack([Z1, Z1], axis=-3),
+        jnp.stack([yq, xq, Y1, X1], axis=-3),
+        jnp.stack([Z1, Z1, zq, zq], axis=-3),
     )
-    n = lb.sub(m1[..., 0, :, :], Y1)
-    d = lb.sub(m1[..., 1, :, :], X1)
+    n = lb.sub(m1[..., 0, :, :], m1[..., 2, :, :])
+    d = lb.sub(m1[..., 1, :, :], m1[..., 3, :, :])
     m2 = tw.fp2_mul(
         jnp.stack([d, n, n, d], axis=-3),
         jnp.stack([Z1, X1, Z1, Y1], axis=-3),
     )
     dZ1, nX1, nZ1, dY1 = (m2[..., i, :, :] for i in range(4))
-    l1 = lb.sub(nX1, dY1)
     scaled = tw.fp2_mul_fp(
-        jnp.stack([tw.fp2_mul_by_xi(dZ1), nZ1], axis=-3),
-        jnp.stack([py, px], axis=-2),
+        jnp.stack([tw.fp2_mul_by_xi(dZ1), nZ1, lb.sub(nX1, dY1)], axis=-3),
+        jnp.stack([py, px, pz], axis=-2),
     )
     l0 = scaled[..., 0, :, :]
     l2 = lb.neg(scaled[..., 1, :, :])
-    q_proj = cv.G2.pack(xq, yq, jnp.broadcast_to(tw.FP2_ONE, xq.shape))
-    return cv.G2.add(t, q_proj), (l0, l1, l2)
+    l1 = scaled[..., 2, :, :]
+    return cv.G2.add(t, q), (l0, l1, l2)
 
 
 # ---------------------------------------------------------------------------
@@ -142,37 +152,51 @@ def _add_step(t, q, px, py):
 # ---------------------------------------------------------------------------
 
 
-def miller_loop(p_aff, q_aff):
-    """Batched per-pair Miller loop.
+def miller_loop_proj(p_proj, q_proj):
+    """Batched per-pair Miller loop on PROJECTIVE inputs (round 4).
 
-    p_aff: (..., 2, L) G1 affine (px, py); q_aff: (..., 2, 2, L) G2 affine
-    twist coords. Returns f: (..., 2, 3, 2, L). Infinity/garbage inputs
-    produce garbage — callers mask per-pair validity afterwards.
-    The BLS x is negative: the result is conjugated (oracle pairing.py:77-78).
+    p_proj: (..., 3, L) G1 projective; q_proj: (..., 3, 2, L) G2 projective
+    twist coords. Returns f: (..., 2, 3, 2, L) equal to the affine-input
+    Miller value times subfield scalars (absorbed by the final
+    exponentiation). Infinity/garbage inputs produce garbage — callers
+    mask per-pair validity afterwards. The BLS x is negative: the result
+    is conjugated (oracle pairing.py:77-78).
     """
-    px = p_aff[..., 0, :]
-    py = p_aff[..., 1, :]
-    xq = q_aff[..., 0, :, :]
-    yq = q_aff[..., 1, :, :]
-    t0 = cv.G2.pack(xq, yq, jnp.broadcast_to(tw.FP2_ONE, xq.shape))
+    px = p_proj[..., 0, :]
+    py = p_proj[..., 1, :]
+    pz = p_proj[..., 2, :]
+    t0 = q_proj
     acc0 = jnp.broadcast_to(tw.FP12_ONE, px.shape[:-1] + tw.FP12_ONE.shape)
 
     def dbl_body(carry, _):
         acc, t = carry
         acc = tw.fp12_sqr(acc)
-        t, (l0, l1, l2) = _dbl_step(t, px, py)
+        t, (l0, l1, l2) = _dbl_step(t, px, py, pz)
         return (tw.fp12_mul_sparse_line(acc, l0, l1, l2), t), None
 
     carry = (acc0, t0)
     for run in _DBL_RUNS:
         carry, _ = jax.lax.scan(dbl_body, carry, None, length=run)
         acc, t = carry
-        t, (l0, l1, l2) = _add_step(t, q_aff, px, py)
+        t, (l0, l1, l2) = _add_step(t, q_proj, px, py, pz)
         carry = (tw.fp12_mul_sparse_line(acc, l0, l1, l2), t)
     if _TAIL_DBLS:
         carry, _ = jax.lax.scan(dbl_body, carry, None, length=_TAIL_DBLS)
     acc, _t = carry
     return tw.fp12_conj(acc)
+
+
+def miller_loop(p_aff, q_aff):
+    """Affine-input adapter (tests/KZG): Z = 1 projective lift."""
+    px = p_aff[..., 0, :]
+    xq = q_aff[..., 0, :, :]
+    p_proj = cv.G1.pack(
+        px, p_aff[..., 1, :], jnp.broadcast_to(lb.ONE_MONT, px.shape)
+    )
+    q_proj = cv.G2.pack(
+        xq, q_aff[..., 1, :, :], jnp.broadcast_to(tw.FP2_ONE, xq.shape)
+    )
+    return miller_loop_proj(p_proj, q_proj)
 
 
 # ---------------------------------------------------------------------------
@@ -251,24 +275,46 @@ def _fp12_reduce_mul(vals, axis_size: int):
     return lb.tree_reduce(vals, tw.fp12_mul, tw.FP12_ONE, axis_size)
 
 
-def multi_pairing_is_one(p_aff, q_aff, mask):
-    """prod_{i: mask} e(P_i, Q_i) == 1 — the core batched check.
+def multi_pairing_is_one_proj(p_proj, q_proj, mask):
+    """prod_{i: mask} e(P_i, Q_i) == 1 on PROJECTIVE inputs — the core
+    batched check (no inversion anywhere before the final exponentiation).
 
-    p_aff: (n, 2, L); q_aff: (n, 2, 2, L); mask: (n,) bool (False entries —
-    padding or infinity pairs — contribute the identity, mirroring the
-    oracle's skip at pairing.py:63). Returns a () bool.
+    p_proj: (n, 3, L); q_proj: (n, 3, 2, L); mask: (n,) bool (False
+    entries — padding or infinity pairs — contribute the identity,
+    mirroring the oracle's skip at pairing.py:63). Returns a () bool.
     """
-    f = miller_loop(p_aff, q_aff)
+    f = miller_loop_proj(p_proj, q_proj)
     f = jnp.where(mask[:, None, None, None, None], f, tw.FP12_ONE)
     prod = _fp12_reduce_mul(f, f.shape[0])
     return tw.fp12_is_one(final_exponentiation(prod))
 
 
+def multi_pairing_is_one(p_aff, q_aff, mask):
+    """Affine-input adapter of multi_pairing_is_one_proj (tests/KZG)."""
+    px = p_aff[..., 0, :]
+    xq = q_aff[..., 0, :, :]
+    p_proj = cv.G1.pack(
+        px, p_aff[..., 1, :], jnp.broadcast_to(lb.ONE_MONT, px.shape)
+    )
+    q_proj = cv.G2.pack(
+        xq, q_aff[..., 1, :, :], jnp.broadcast_to(tw.FP2_ONE, xq.shape)
+    )
+    return multi_pairing_is_one_proj(p_proj, q_proj, mask)
+
+
 def to_affine_g1(p_proj):
     """Batched projective->affine for G1: (..., 3, L) -> (..., 2, L).
-    Infinity maps to (0, 0) (Z=0 => inv(0)=0); callers carry a mask."""
+    Infinity maps to (0, 0); callers carry a mask.
+
+    Off the verify hot path since the projective Miller loop (round 4) —
+    remaining callers (KZG pair staging, tests) use Montgomery batch
+    inversion: ONE Fermat ladder for the whole batch (lb.batch_inv) with
+    the documented mask-to-1 substitution for infinity rows."""
     X, Y, Z = cv.G1.coords(p_proj)
-    zinv = lb.inv(Z)
+    inf = lb.is_zero(Z)                        # value-zero (canonicalizing)
+    z_safe = lb.select(inf, jnp.broadcast_to(lb.ONE_MONT, Z.shape), Z)
+    zinv = lb.batch_inv(z_safe.reshape(-1, lb.L)).reshape(Z.shape)
+    zinv = lb.select(inf, jnp.zeros_like(zinv), zinv)
     xy = lb.mont_mul(
         jnp.stack([X, Y], axis=-2), jnp.broadcast_to(zinv[..., None, :], X.shape[:-1] + (2, lb.L))
     )
@@ -276,9 +322,20 @@ def to_affine_g1(p_proj):
 
 
 def to_affine_g2(p_proj):
-    """Batched projective->affine for G2: (..., 3, 2, L) -> (..., 2, 2, L)."""
+    """Batched projective->affine for G2: (..., 3, 2, L) -> (..., 2, 2, L).
+    Same batch-inversion structure as to_affine_g1, on the Fp norms of Z
+    (fp2_inv = conj(Z) * norm^-1)."""
     X, Y, Z = cv.G2.coords(p_proj)
-    zinv = tw.fp2_inv(Z)
+    inf = tw.fp2_is_zero(Z)
+    z0, z1 = Z[..., 0, :], Z[..., 1, :]
+    sq = lb.mont_mul(
+        jnp.stack([z0, z1], axis=-2), jnp.stack([z0, z1], axis=-2)
+    )
+    norm = lb.add(sq[..., 0, :], sq[..., 1, :])
+    n_safe = lb.select(inf, jnp.broadcast_to(lb.ONE_MONT, norm.shape), norm)
+    ninv = lb.batch_inv(n_safe.reshape(-1, lb.L)).reshape(norm.shape)
+    ninv = lb.select(inf, jnp.zeros_like(ninv), ninv)
+    zinv = lb.mont_mul(tw.fp2_conj(Z), ninv[..., None, :])
     xy = tw.fp2_mul(
         jnp.stack([X, Y], axis=-3),
         jnp.broadcast_to(zinv[..., None, :, :], X.shape[:-2] + (2, 2, lb.L)),

@@ -129,6 +129,12 @@ def _out4(c):
     return lb.ntt_dom_to_limbs(c, lb.plan4(), lb.offset_dom4())
 
 
+def _out4_light(c):
+    """Fp12-level outputs feed the next multiply (or a select/conj/one
+    sub) — the cheap reduction applies (lb._reduce_light bounds)."""
+    return lb.ntt_dom_to_limbs(c, lb.plan4(), lb.offset_dom4(), light=True)
+
+
 # ---------------------------------------------------------------------------
 # Fp2
 # ---------------------------------------------------------------------------
@@ -437,8 +443,13 @@ def _st12(c0, c1):
 def fp12_mul(a, b):
     """Domain schoolbook: 12 forwards per operand, 144 pointwise products,
     12 interpolations (vs 108 forwards + 54 interpolations for the
-    batched-Karatsuba path, kept under LIGHTHOUSE_TPU_TOWER_NTT=0)."""
+    batched-Karatsuba path, kept under LIGHTHOUSE_TPU_TOWER_NTT=0).
+    Whole-op Pallas kernel on TPU (ops/fused.py K3): the domain tensors
+    never leave VMEM."""
     a, b = jnp.broadcast_arrays(a, b)
+    from . import fused
+    if _TOWER_NTT and fused.k3_enabled():
+        return fused.fp12_op("mul", a, b=b)
     if _TOWER_NTT:
         fa, fb = _fwd4(a), _fwd4(b)
         A0, A1 = fa[..., 0, :, :, :, :], fa[..., 1, :, :, :, :]
@@ -447,7 +458,7 @@ def fp12_mul(a, b):
         t1 = _d6mul(A1, B1)
         c0 = t0 + _d6mul_by_v(t1)
         c1 = _d6mul(A0, B1) + _d6mul(A1, B0)
-        return _out4(jnp.stack([c0, c1], axis=-5))
+        return _out4_light(jnp.stack([c0, c1], axis=-5))
     a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
     b0, b1 = b[..., 0, :, :, :], b[..., 1, :, :, :]
     pre = lb.add(jnp.stack([a0, b0], axis=-4), jnp.stack([a1, b1], axis=-4))
@@ -462,6 +473,9 @@ def fp12_mul(a, b):
 
 
 def fp12_sqr(a):
+    from . import fused
+    if _TOWER_NTT and fused.k3_enabled():
+        return fused.fp12_op("sqr", a)
     if _TOWER_NTT:
         fa = _fwd4(a)
         A0, A1 = fa[..., 0, :, :, :, :], fa[..., 1, :, :, :, :]
@@ -469,7 +483,7 @@ def fp12_sqr(a):
         t1 = _d6mul(A1, A1)
         c0 = t0 + _d6mul_by_v(t1)
         c1 = 2.0 * _d6mul(A0, A1)
-        return _out4(jnp.stack([c0, c1], axis=-5))
+        return _out4_light(jnp.stack([c0, c1], axis=-5))
     return fp12_mul(a, a)
 
 
@@ -483,6 +497,9 @@ def fp12_mul_sparse_line(a, l0, l1, l2):
     A L0 is a coefficient-wise scale (3 muls); B L1 expands with v^3 = xi to
     (xi(b1 l2 + b2 l1), b0 l1 + xi(b2 l2), b0 l2 + b1 l1) (6 muls);
     (L0+L1) is dense so the cross term is one fp6_mul (6 muls)."""
+    from . import fused
+    if _TOWER_NTT and fused.k3_enabled():
+        return fused.fp12_op("line", a, line=(l0, l1, l2))
     if _TOWER_NTT:
         fa = _fwd4(a)                                  # (..., 2,3,2,np,N)
         fl = _fwd4(jnp.stack([l0, l1, l2], axis=-3))   # (..., 3,2,np,N)
@@ -517,7 +534,7 @@ def fp12_mul_sparse_line(a, l0, l1, l2):
         )
         c0 = t0 + _d6mul_by_v(t1)
         c1 = t2 + t3
-        return _out4(jnp.stack([c0, c1], axis=-5))
+        return _out4_light(jnp.stack([c0, c1], axis=-5))
     A = a[..., 0, :, :, :]
     B = a[..., 1, :, :, :]
     a0, a1, a2 = A[..., 0, :, :], A[..., 1, :, :], A[..., 2, :, :]

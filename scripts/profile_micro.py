@@ -1,0 +1,114 @@
+"""Micro-profile of the field/curve/pairing layers on the device.
+
+Times each building block of the verify pipeline at production-like
+shapes to locate the bottleneck (MXU matmul vs elementwise carry/CRT
+machinery vs fixed latency). Informs NOTES_TPU_PERF.md's roofline and
+the round-4 fusion work.
+
+Usage: python scripts/profile_micro.py [n_sets]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def bench(fn, *args, iters=5, warmup=2):
+    import jax
+    f = jax.jit(fn)
+    out = f(*args)
+    jax.block_until_ready(out)
+    for _ in range(warmup - 1):
+        jax.block_until_ready(f(*args))
+    t0 = time.monotonic()
+    for _ in range(iters):
+        out = f(*args)
+    jax.block_until_ready(out)
+    return (time.monotonic() - t0) / iters
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+    import jax
+    import jax.numpy as jnp
+
+    from lighthouse_tpu.ops import curves as cv
+    from lighthouse_tpu.ops import h2c
+    from lighthouse_tpu.ops import limbs as lb
+    from lighthouse_tpu.ops import pairing as pr
+    from lighthouse_tpu.ops import tower as tw
+
+    print(f"devices: {jax.devices()}  n={n}", file=sys.stderr)
+    rng = np.random.default_rng(7)
+
+    def rand_fp(shape):
+        return jnp.asarray(
+            rng.integers(0, 256, size=shape + (lb.L,)).astype(np.float32))
+
+    results = {}
+
+    # --- raw field layer at the fp12-mul row count (12 coords x n) -------
+    rows = 12 * n
+    a = rand_fp((rows,))
+    b = rand_fp((rows,))
+    results[f"lb.mul ({rows},L)"] = bench(lb.mul, a, b)
+    results[f"lb.sqr ({rows},L)"] = bench(lb.sqr, a)
+    sq = jax.jit(lb._squeeze)(a)
+    results[f"_squeeze ({rows},L)"] = bench(lb._squeeze, a)
+    results[f"ntt_fwd ({rows},51)"] = bench(lb.ntt_fwd, sq)
+    fa = jax.jit(lb.ntt_fwd)(sq)
+    prod = fa * fa
+    results[f"ntt_inv_cols ({rows})"] = bench(lb.ntt_inv_cols, prod)
+    cols = jax.jit(lb.ntt_inv_cols)(prod)
+    results[f"_reduce f5 ({rows})"] = bench(lb._reduce, cols)
+    results[f"_reduce f2 ({rows})"] = bench(lambda x: lb._reduce(x, folds=2), cols)
+    results[f"canonicalize ({rows},L)"] = bench(lb.canonicalize, a)
+
+    # --- tower ops at pairing shapes -------------------------------------
+    f12 = rand_fp((n, 2, 3, 2))
+    g12 = rand_fp((n, 2, 3, 2))
+    l0 = rand_fp((n, 2))
+    l1 = rand_fp((n, 2))
+    l2 = rand_fp((n, 2))
+    results["fp12_sqr (n)"] = bench(tw.fp12_sqr, f12)
+    results["fp12_mul (n)"] = bench(tw.fp12_mul, f12, g12)
+    results["fp12_sparse_line (n)"] = bench(tw.fp12_mul_sparse_line, f12, l0, l1, l2)
+    f2a = rand_fp((n, 13, 2))
+    f2b = rand_fp((n, 13, 2))
+    results["fp2_mul (n,13)"] = bench(tw.fp2_mul, f2a, f2b)
+
+    # --- curve/pairing stages --------------------------------------------
+    p1 = jnp.broadcast_to(cv.G1_GEN, (n, 3, lb.L))
+    p2 = jnp.broadcast_to(cv.G2_GEN, (n, 3, 2, lb.L))
+    sc = jnp.asarray(rng.integers(1, 2**63, size=(n,)).astype(np.uint64))
+    results["G1.mul_var_scalar (n)"] = bench(cv.G1.mul_var_scalar, p1, sc)
+    results["G2.mul_var_scalar (n)"] = bench(cv.G2.mul_var_scalar, p2, sc)
+    results["g2_in_subgroup (n)"] = bench(cv.g2_in_subgroup, p2)
+    results["to_affine_g1 (n)"] = bench(pr.to_affine_g1, p1)
+    results["to_affine_g2 (n)"] = bench(pr.to_affine_g2, p2)
+    results["g2_clear_cofactor (n)"] = bench(cv.g2_clear_cofactor, p2)
+
+    p1a = jax.jit(pr.to_affine_g1)(p1)
+    p2a = jax.jit(pr.to_affine_g2)(p2)
+    results["miller_loop (n)"] = bench(pr.miller_loop, p1a, p2a)
+    results["final_exp (1)"] = bench(pr.final_exponentiation, f12[:1])
+    results["final_exp (n)"] = bench(pr.final_exponentiation, f12)
+    mask = jnp.ones((n,), dtype=bool)
+    results["multi_pairing_is_one (n)"] = bench(
+        pr.multi_pairing_is_one, p1a, p2a, mask)
+
+    # --- h2c -------------------------------------------------------------
+    u = rand_fp((n, 2, 2))
+    results["sswu map (n)"] = bench(h2c.map_to_curve_sswu_projective, u)
+    results["hash_to_g2_device (n)"] = bench(h2c.hash_to_g2_device, u)
+
+    for k, v in results.items():
+        print(f"{k:36s} {v * 1e3:10.2f} ms")
+
+
+if __name__ == "__main__":
+    main()
