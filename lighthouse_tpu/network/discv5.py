@@ -1,0 +1,679 @@
+"""discv5 v5.1 wire protocol (packet masking, WHOAREYOU handshake,
+session keys, NODES exchange) — the real UDP discovery layer.
+
+Replaces the repo's private ``("disc_findnode", ...)`` envelope with the
+spec packet formats the reference speaks through the sigp/discv5 crate
+(/root/reference/beacon_node/lighthouse_network/src/discovery/mod.rs:1-14
+drives it; enr.rs builds the records this module carries). Structure:
+
+  packet        = masking-iv || masked-header || message
+  masked-header = aesctr_encrypt(dest-id[:16], masking-iv, header)
+  header        = "discv5" || version(0x0001) || flag || nonce(12)
+                  || authdata-size(2) || authdata
+  flag 0 (message):   authdata = src-node-id (32); message is
+                      AES-128-GCM under the session key, nonce = packet
+                      nonce, AD = masking-iv || header.
+  flag 1 (WHOAREYOU): authdata = id-nonce (16) || enr-seq (8); no
+                      message. ``challenge-data`` (masking-iv || header)
+                      seeds the handshake KDF and id-proof.
+  flag 2 (handshake): authdata = src-node-id || sig-size || eph-key-size
+                      || id-signature || eph-pubkey || [record]; message
+                      as flag 0 under the freshly-derived key.
+
+Key agreement (spec §"Session keys"): secp256k1 ECDH with the COMPRESSED
+shared point as the secret, HKDF-SHA256 with salt = challenge-data and
+info = "discovery v5 key agreement" || node-id-A || node-id-B ->
+initiator-key (16) || recipient-key (16). Identity proof: 64-byte low-s
+ECDSA over sha256("discovery v5 identity proof" || challenge-data ||
+ephemeral-pubkey || node-id-B).
+
+Messages are RLP: PING(0x01)/PONG(0x02)/FINDNODE(0x03)/NODES(0x04),
+FINDNODE carrying log2-distance lists per v5.1.
+
+KATs: tests/test_discv5.py checks the official spec test vectors
+(devp2p discv5-wire-test-vectors.md) in the decrypt/verify direction —
+the AES-GCM tag and ECDSA verification cryptographically pin both the
+vectors and this implementation.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import secrets
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+from .enr import (
+    Enr,
+    EnrError,
+    _pubkey_from_compressed,
+    compressed_pubkey,
+    rlp_decode,
+    rlp_encode,
+)
+
+PROTOCOL_ID = b"discv5"
+VERSION = b"\x00\x01"
+FLAG_MESSAGE = 0
+FLAG_WHOAREYOU = 1
+FLAG_HANDSHAKE = 2
+
+MSG_PING = 0x01
+MSG_PONG = 0x02
+MSG_FINDNODE = 0x03
+MSG_NODES = 0x04
+
+ID_SIGNATURE_TEXT = b"discovery v5 identity proof"
+KDF_INFO_TEXT = b"discovery v5 key agreement"
+
+MIN_PACKET_SIZE = 63
+MAX_PACKET_SIZE = 1280
+
+_SECP_P = 2**256 - 2**32 - 977
+_SECP_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+_SECP_GX = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+_SECP_GY = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+
+
+class Discv5Error(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# secp256k1 scalar multiplication (pure python — `cryptography` exposes
+# only x-coordinate ECDH, but the spec secret is the COMPRESSED point)
+# ---------------------------------------------------------------------------
+
+
+def _pt_add(p, q):
+    if p is None:
+        return q
+    if q is None:
+        return p
+    (x1, y1), (x2, y2) = p, q
+    if x1 == x2 and (y1 + y2) % _SECP_P == 0:
+        return None
+    if p == q:
+        lam = (3 * x1 * x1) * pow(2 * y1, -1, _SECP_P) % _SECP_P
+    else:
+        lam = (y2 - y1) * pow(x2 - x1, -1, _SECP_P) % _SECP_P
+    x3 = (lam * lam - x1 - x2) % _SECP_P
+    y3 = (lam * (x1 - x3) - y1) % _SECP_P
+    return (x3, y3)
+
+
+def _pt_mul(k: int, pt) -> Optional[Tuple[int, int]]:
+    acc = None
+    add = pt
+    while k:
+        if k & 1:
+            acc = _pt_add(acc, add)
+        add = _pt_add(add, add)
+        k >>= 1
+    return acc
+
+
+def _decompress(data: bytes) -> Tuple[int, int]:
+    if len(data) != 33 or data[0] not in (2, 3):
+        raise Discv5Error("bad compressed point")
+    x = int.from_bytes(data[1:], "big")
+    y2 = (pow(x, 3, _SECP_P) + 7) % _SECP_P
+    y = pow(y2, (_SECP_P + 1) // 4, _SECP_P)
+    if y * y % _SECP_P != y2:
+        raise Discv5Error("not on curve")
+    if (y & 1) != (data[0] & 1):
+        y = _SECP_P - y
+    return (x, y)
+
+
+def _compress(pt: Tuple[int, int]) -> bytes:
+    x, y = pt
+    return bytes([2 + (y & 1)]) + x.to_bytes(32, "big")
+
+
+def ecdh(private_key, peer_pubkey_compressed: bytes) -> bytes:
+    """Spec ECDH: compressed secp256k1 point of priv * peer_pub."""
+    k = private_key.private_numbers().private_value
+    shared = _pt_mul(k, _decompress(peer_pubkey_compressed))
+    if shared is None:
+        raise Discv5Error("ECDH produced infinity")
+    return _compress(shared)
+
+
+# ---------------------------------------------------------------------------
+# KDF + identity proof
+# ---------------------------------------------------------------------------
+
+
+def _hkdf_extract(salt: bytes, ikm: bytes) -> bytes:
+    return hmac.new(salt, ikm, hashlib.sha256).digest()
+
+
+def _hkdf_expand(prk: bytes, info: bytes, length: int) -> bytes:
+    out, t, i = b"", b"", 1
+    while len(out) < length:
+        t = hmac.new(prk, t + info + bytes([i]), hashlib.sha256).digest()
+        out += t
+        i += 1
+    return out[:length]
+
+
+def derive_session_keys(secret: bytes, node_id_a: bytes, node_id_b: bytes,
+                        challenge_data: bytes) -> Tuple[bytes, bytes]:
+    """-> (initiator_key, recipient_key), each 16 bytes (spec KDF)."""
+    info = KDF_INFO_TEXT + node_id_a + node_id_b
+    prk = _hkdf_extract(challenge_data, secret)
+    key_data = _hkdf_expand(prk, info, 32)
+    return key_data[:16], key_data[16:]
+
+
+def id_sign(key, challenge_data: bytes, eph_pubkey: bytes,
+            dest_node_id: bytes) -> bytes:
+    """64-byte low-s ECDSA over the spec id-proof input."""
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        Prehashed,
+        decode_dss_signature,
+    )
+
+    digest = hashlib.sha256(
+        ID_SIGNATURE_TEXT + challenge_data + eph_pubkey + dest_node_id
+    ).digest()
+    der = key.sign(digest, ec.ECDSA(Prehashed(hashes.SHA256())))
+    r, s = decode_dss_signature(der)
+    if s > _SECP_N // 2:
+        s = _SECP_N - s
+    return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+
+def id_verify(pubkey_compressed: bytes, signature: bytes,
+              challenge_data: bytes, eph_pubkey: bytes,
+              dest_node_id: bytes) -> bool:
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        Prehashed,
+        encode_dss_signature,
+    )
+
+    if len(signature) != 64:
+        return False
+    digest = hashlib.sha256(
+        ID_SIGNATURE_TEXT + challenge_data + eph_pubkey + dest_node_id
+    ).digest()
+    der = encode_dss_signature(
+        int.from_bytes(signature[:32], "big"),
+        int.from_bytes(signature[32:], "big"),
+    )
+    try:
+        _pubkey_from_compressed(pubkey_compressed).verify(
+            der, digest, ec.ECDSA(Prehashed(hashes.SHA256()))
+        )
+        return True
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Packet codec
+# ---------------------------------------------------------------------------
+
+
+def _aes_ctr(key: bytes, iv: bytes, data: bytes) -> bytes:
+    c = Cipher(algorithms.AES(key), modes.CTR(iv)).encryptor()
+    return c.update(data) + c.finalize()
+
+
+class Header:
+    def __init__(self, flag: int, nonce: bytes, authdata: bytes):
+        self.flag = flag
+        self.nonce = nonce
+        self.authdata = authdata
+
+    def encode(self) -> bytes:
+        return (PROTOCOL_ID + VERSION + bytes([self.flag]) + self.nonce
+                + len(self.authdata).to_bytes(2, "big") + self.authdata)
+
+
+def encode_packet(dest_node_id: bytes, header: Header,
+                  message: bytes = b"", masking_iv: Optional[bytes] = None
+                  ) -> bytes:
+    iv = masking_iv if masking_iv is not None else secrets.token_bytes(16)
+    masked = _aes_ctr(dest_node_id[:16], iv, header.encode())
+    return iv + masked + message
+
+
+def decode_header(local_node_id: bytes, packet: bytes
+                  ) -> Tuple[Header, bytes, bytes]:
+    """-> (header, message_bytes, header_plain_bytes). Raises on junk."""
+    if len(packet) < MIN_PACKET_SIZE - 24 or len(packet) > MAX_PACKET_SIZE:
+        raise Discv5Error("bad packet size")
+    iv = packet[:16]
+    dec = Cipher(
+        algorithms.AES(local_node_id[:16]), modes.CTR(iv)
+    ).decryptor()
+    static = dec.update(packet[16:39])          # 23-byte static header
+    if static[:6] != PROTOCOL_ID or static[6:8] != VERSION:
+        raise Discv5Error("bad protocol id")
+    flag = static[8]
+    if flag not in (FLAG_MESSAGE, FLAG_WHOAREYOU, FLAG_HANDSHAKE):
+        raise Discv5Error("bad flag")
+    nonce = static[9:21]
+    authdata_size = int.from_bytes(static[21:23], "big")
+    if 39 + authdata_size > len(packet):
+        raise Discv5Error("truncated authdata")
+    authdata = dec.update(packet[39:39 + authdata_size])
+    message = packet[39 + authdata_size:]
+    header = Header(flag, nonce, authdata)
+    return header, message, iv + static + authdata
+
+
+def challenge_data_of(masking_iv: bytes, header: Header) -> bytes:
+    return masking_iv + header.encode()
+
+
+def encrypt_message(key: bytes, nonce: bytes, plaintext: bytes,
+                    ad: bytes) -> bytes:
+    return AESGCM(key).encrypt(nonce, plaintext, ad)
+
+
+def decrypt_message(key: bytes, nonce: bytes, ciphertext: bytes,
+                    ad: bytes) -> bytes:
+    try:
+        return AESGCM(key).decrypt(nonce, ciphertext, ad)
+    except Exception as exc:
+        raise Discv5Error("message decrypt failed") from exc
+
+
+# ---------------------------------------------------------------------------
+# Messages (RLP)
+# ---------------------------------------------------------------------------
+
+
+def _int_bytes(v: int) -> bytes:
+    return v.to_bytes((v.bit_length() + 7) // 8, "big") if v else b""
+
+
+def encode_ping(req_id: bytes, enr_seq: int) -> bytes:
+    return bytes([MSG_PING]) + rlp_encode([req_id, enr_seq])
+
+
+def encode_pong(req_id: bytes, enr_seq: int, ip: bytes, port: int) -> bytes:
+    return bytes([MSG_PONG]) + rlp_encode([req_id, enr_seq, ip, port])
+
+
+def encode_findnode(req_id: bytes, distances: List[int]) -> bytes:
+    return bytes([MSG_FINDNODE]) + rlp_encode([req_id, list(distances)])
+
+
+def encode_nodes(req_id: bytes, total: int, enrs: List[Enr]) -> bytes:
+    # Each ENR is itself an RLP list: embed its decoded structure.
+    items = [rlp_decode(e.to_rlp()) for e in enrs]
+    return bytes([MSG_NODES]) + rlp_encode([req_id, total, items])
+
+
+def decode_message(data: bytes):
+    """-> (msg_type, fields). ENRs in NODES come back as Enr objects."""
+    if not data:
+        raise Discv5Error("empty message")
+    mtype = data[0]
+    body = rlp_decode(data[1:])
+    if not isinstance(body, list):
+        raise Discv5Error("bad message body")
+    if mtype == MSG_NODES:
+        req_id, total, enr_items = body[0], body[1], body[2]
+        enrs = []
+        for item in enr_items:
+            try:
+                enrs.append(Enr.from_rlp(rlp_encode(item)))
+            except (EnrError, Exception):
+                continue            # unverifiable records never admitted
+        return mtype, (req_id, _to_int(total), enrs)
+    return mtype, body
+
+
+def _to_int(v) -> int:
+    if isinstance(v, bytes):
+        return int.from_bytes(v, "big")
+    return int(v)
+
+
+# ---------------------------------------------------------------------------
+# Session service over UDP
+# ---------------------------------------------------------------------------
+
+
+class Session:
+    def __init__(self, send_key: bytes, recv_key: bytes):
+        self.send_key = send_key
+        self.recv_key = recv_key
+
+
+class Discv5Service:
+    """Minimal-but-real discv5 node: UDP socket, session establishment via
+    WHOAREYOU handshake, PING/PONG + FINDNODE/NODES, Kademlia-ish table.
+
+    The lookup/table logic mirrors network/discovery.py (same admission
+    rules); this class replaces its tagged-frame wire with spec packets.
+    """
+
+    MAX_NODES_RESPONSE = 16
+
+    def __init__(self, key, enr: Enr, bind: Tuple[str, int] = ("127.0.0.1", 0)):
+        self.key = key
+        self.local_enr = enr
+        self.node_id = enr.node_id
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind(bind)
+        self.sock.settimeout(0.1)
+        self.port = self.sock.getsockname()[1]
+        self.records: Dict[bytes, Enr] = {}
+        self.sessions: Dict[bytes, Session] = {}
+        # nonce -> (dest_node_id, dest_pubkey, addr, pending_message)
+        self._pending_out: Dict[bytes, tuple] = {}
+        # (addr, nonce-of-our-whoareyou) -> challenge-data
+        self._challenges: Dict[bytes, bytes] = {}
+        self._responses: Dict[bytes, list] = {}
+        self._response_cv = threading.Condition()
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self.stats = {"whoareyou_sent": 0, "handshakes": 0, "nodes_served": 0}
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "Discv5Service":
+        self._running = True
+        self._thread = threading.Thread(target=self._recv_loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread:
+            self._thread.join(timeout=2)
+            self._thread = None
+        self.sock.close()
+
+    # -------------------------------------------------------------- table
+
+    def add_enr(self, enr: Enr) -> None:
+        if enr.node_id == self.node_id:
+            return
+        existing = self.records.get(enr.node_id)
+        if existing is None or enr.seq > existing.seq:
+            self.records[enr.node_id] = enr
+
+    def _addr_of(self, enr: Enr) -> Optional[Tuple[str, int]]:
+        if enr.ip is None or enr.udp is None:
+            return None
+        return (enr.ip, enr.udp)
+
+    # ------------------------------------------------------------- sending
+
+    def _send_message(self, dest: Enr, message: bytes) -> None:
+        addr = self._addr_of(dest)
+        if addr is None:
+            raise Discv5Error("record has no ip/udp")
+        sess = self.sessions.get(dest.node_id)
+        nonce = secrets.token_bytes(12)
+        header = Header(FLAG_MESSAGE, nonce, self.node_id)
+        iv = secrets.token_bytes(16)
+        if sess is None:
+            # No session: random-looking filler triggers WHOAREYOU (spec
+            # §"Sessions": senders MAY transmit random data).
+            self._pending_out[nonce] = (dest.node_id, dest.pubkey, addr,
+                                        message)
+            body = secrets.token_bytes(max(16, len(message)))
+            self.sock.sendto(
+                encode_packet(dest.node_id, header, body, iv), addr)
+            return
+        ad = iv + header.encode()
+        body = encrypt_message(sess.send_key, nonce, message, ad)
+        self._pending_out[nonce] = (dest.node_id, dest.pubkey, addr, message)
+        self.sock.sendto(encode_packet(dest.node_id, header, body, iv), addr)
+
+    def ping(self, dest: Enr, timeout: float = 2.0) -> bool:
+        req_id = secrets.token_bytes(8)
+        self._send_message(dest, encode_ping(req_id, self.local_enr.seq))
+        return self._await_response(req_id, timeout) is not None
+
+    def find_node(self, dest: Enr, distances: List[int],
+                  timeout: float = 2.0) -> List[Enr]:
+        req_id = secrets.token_bytes(8)
+        self._send_message(dest, encode_findnode(req_id, distances))
+        got = self._await_response(req_id, timeout)
+        return got or []
+
+    def lookup(self, bootstrap: List[Enr], want: int = 16) -> List[Enr]:
+        """Self-lookup: FINDNODE at descending distances from each
+        bootstrap/closest node (discv5's recursive lookup, depth-bounded)."""
+        for enr in bootstrap:
+            self.add_enr(enr)
+        queried = set()
+        for _round in range(3):
+            candidates = sorted(
+                self.records.values(),
+                key=lambda e: int.from_bytes(e.node_id, "big")
+                ^ int.from_bytes(self.node_id, "big"),
+            )
+            todo = [e for e in candidates if e.node_id not in queried][:3]
+            if not todo:
+                break
+            for enr in todo:
+                queried.add(enr.node_id)
+                d = _log2_distance(enr.node_id, self.node_id)
+                # The self-distance bucket plus the top buckets: random
+                # 256-bit ids concentrate at distance ~256, so a fresh
+                # lookup that only probed d±1 would miss most of a
+                # sparse table (discv5 iterates buckets the same way).
+                dists = sorted({max(1, min(256, x))
+                                for x in (d, d - 1, d + 1,
+                                          *range(249, 257))})
+                for rec in self.find_node(enr, dists):
+                    self.add_enr(rec)
+        out = sorted(
+            self.records.values(),
+            key=lambda e: int.from_bytes(e.node_id, "big")
+            ^ int.from_bytes(self.node_id, "big"),
+        )
+        return out[:want]
+
+    def _await_response(self, req_id: bytes, timeout: float):
+        deadline = time.monotonic() + timeout
+        with self._response_cv:
+            while req_id not in self._responses:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._response_cv.wait(remaining)
+            return self._responses.pop(req_id)
+
+    # ------------------------------------------------------------ receiving
+
+    def _recv_loop(self) -> None:
+        while self._running:
+            try:
+                packet, addr = self.sock.recvfrom(MAX_PACKET_SIZE + 1)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                self._handle_packet(packet, addr)
+            except Discv5Error:
+                continue
+            except Exception:
+                continue
+
+    def _handle_packet(self, packet: bytes, addr) -> None:
+        header, message, plain = decode_header(self.node_id, packet)
+        if header.flag == FLAG_WHOAREYOU:
+            # challenge-data = masking-iv || static-header || authdata of
+            # the WHOAREYOU packet as received (= the unmasked plain bytes).
+            self._on_whoareyou(header, plain, addr)
+        elif header.flag == FLAG_MESSAGE:
+            self._on_message(header, message, packet[:16], addr)
+        elif header.flag == FLAG_HANDSHAKE:
+            self._on_handshake(header, message, packet[:16], addr)
+
+    # -- WHOAREYOU: we are the initiator; complete the handshake ----------
+
+    def _on_whoareyou(self, header: Header, challenge_data: bytes,
+                      addr) -> None:
+        from .enr import generate_key
+
+        pending = self._pending_out.pop(header.nonce, None)
+        if pending is None:
+            return
+        dest_node_id, dest_pubkey, dest_addr, message = pending
+        if len(header.authdata) != 24:
+            raise Discv5Error("bad WHOAREYOU authdata")
+        enr_seq = int.from_bytes(header.authdata[16:24], "big")
+
+        eph = generate_key()
+        eph_pub = compressed_pubkey(eph)
+        secret = ecdh(eph, dest_pubkey)
+        ikey, rkey = derive_session_keys(
+            secret, self.node_id, dest_node_id, challenge_data)
+        sig = id_sign(self.key, challenge_data, eph_pub, dest_node_id)
+        record = (self.local_enr.to_rlp()
+                  if enr_seq < self.local_enr.seq else b"")
+        authdata = (self.node_id + bytes([len(sig)]) + bytes([len(eph_pub)])
+                    + sig + eph_pub + record)
+        nonce = secrets.token_bytes(12)
+        hs = Header(FLAG_HANDSHAKE, nonce, authdata)
+        iv = secrets.token_bytes(16)
+        ad = iv + hs.encode()
+        body = encrypt_message(ikey, nonce, message, ad)
+        # We initiated: we send with initiator-key, read with recipient-key.
+        self.sessions[dest_node_id] = Session(send_key=ikey, recv_key=rkey)
+        self._pending_out[nonce] = (dest_node_id, dest_pubkey, dest_addr,
+                                    message)
+        self.stats["handshakes"] += 1
+        self.sock.sendto(encode_packet(dest_node_id, hs, body, iv),
+                         dest_addr)
+
+    def _on_message(self, header: Header, message: bytes, iv: bytes,
+                    addr) -> None:
+        src_id = header.authdata
+        if len(src_id) != 32:
+            raise Discv5Error("bad src id")
+        sess = self.sessions.get(src_id)
+        if sess is not None:
+            ad = iv + header.encode()
+            try:
+                pt = decrypt_message(sess.recv_key, header.nonce, message, ad)
+            except Discv5Error:
+                sess = None
+            else:
+                self._dispatch(src_id, pt, addr)
+                return
+        # Unknown session / decrypt failure: WHOAREYOU challenge.
+        known = self.records.get(src_id)
+        id_nonce = secrets.token_bytes(16)
+        seq = known.seq if known is not None else 0
+        way = Header(FLAG_WHOAREYOU, header.nonce,
+                     id_nonce + seq.to_bytes(8, "big"))
+        iv_out = secrets.token_bytes(16)
+        self._challenges[src_id] = challenge_data_of(iv_out, way)
+        self.stats["whoareyou_sent"] += 1
+        self.sock.sendto(encode_packet(src_id, way, b"", iv_out), addr)
+
+    def _on_handshake(self, header: Header, message: bytes, iv: bytes,
+                      addr) -> None:
+        ad_auth = header.authdata
+        if len(ad_auth) < 34:
+            raise Discv5Error("short handshake authdata")
+        src_id = ad_auth[:32]
+        sig_size = ad_auth[32]
+        eph_size = ad_auth[33]
+        if len(ad_auth) < 34 + sig_size + eph_size:
+            raise Discv5Error("truncated handshake authdata")
+        sig = ad_auth[34:34 + sig_size]
+        eph_pub = ad_auth[34 + sig_size:34 + sig_size + eph_size]
+        record_raw = ad_auth[34 + sig_size + eph_size:]
+        challenge_data = self._challenges.pop(src_id, None)
+        if challenge_data is None:
+            raise Discv5Error("handshake without challenge")
+        enr = None
+        if record_raw:
+            enr = Enr.from_rlp(rlp_encode(rlp_decode(record_raw)))
+        else:
+            enr = self.records.get(src_id)
+        if enr is None or enr.node_id != src_id:
+            raise Discv5Error("no record for handshake peer")
+        if not id_verify(enr.pubkey, sig, challenge_data, eph_pub,
+                         self.node_id):
+            raise Discv5Error("bad id signature")
+        secret = ecdh(self.key, eph_pub)
+        ikey, rkey = derive_session_keys(
+            secret, src_id, self.node_id, challenge_data)
+        # Peer initiated: they send with initiator-key; we reply with
+        # recipient-key.
+        sess = Session(send_key=rkey, recv_key=ikey)
+        ad = iv + header.encode()
+        pt = decrypt_message(sess.recv_key, header.nonce, message, ad)
+        self.sessions[src_id] = sess
+        self.add_enr(enr)
+        self.stats["handshakes"] += 1
+        self._dispatch(src_id, pt, addr)
+
+    # -- message dispatch --------------------------------------------------
+
+    def _reply(self, src_id: bytes, addr, message: bytes) -> None:
+        """Respond over the established session directly to the sender's
+        address (no record needed — mirrors discv5 answering from the
+        packet's source endpoint)."""
+        sess = self.sessions.get(src_id)
+        if sess is None:
+            return
+        nonce = secrets.token_bytes(12)
+        header = Header(FLAG_MESSAGE, nonce, self.node_id)
+        iv = secrets.token_bytes(16)
+        ad = iv + header.encode()
+        body = encrypt_message(sess.send_key, nonce, message, ad)
+        self.sock.sendto(encode_packet(src_id, header, body, iv), addr)
+
+    def _dispatch(self, src_id: bytes, plaintext: bytes, addr) -> None:
+        mtype, fields = decode_message(plaintext)
+        if mtype == MSG_PING:
+            req_id = fields[0]
+            ip_b = socket.inet_aton(addr[0])
+            self._reply(src_id, addr,
+                        encode_pong(req_id, self.local_enr.seq, ip_b,
+                                    addr[1]))
+        elif mtype == MSG_PONG:
+            req_id = fields[0]
+            with self._response_cv:
+                self._responses[bytes(req_id)] = [fields]
+                self._response_cv.notify_all()
+        elif mtype == MSG_FINDNODE:
+            req_id, distances = fields[0], fields[1]
+            dists = [_to_int(d) for d in (
+                distances if isinstance(distances, list) else [distances])]
+            matches = [
+                e for e in list(self.records.values()) + [self.local_enr]
+                if _log2_distance(e.node_id, self.node_id) in dists
+            ][: self.MAX_NODES_RESPONSE]
+            self.stats["nodes_served"] += len(matches)
+            self._reply(src_id, addr, encode_nodes(req_id, 1, matches))
+        elif mtype == MSG_NODES:
+            req_id, _total, enrs = fields
+            for e in enrs:
+                self.add_enr(e)
+            with self._response_cv:
+                self._responses[bytes(req_id)] = enrs
+                self._response_cv.notify_all()
+
+
+def _log2_distance(a: bytes, b: bytes) -> int:
+    d = int.from_bytes(a, "big") ^ int.from_bytes(b, "big")
+    return d.bit_length()

@@ -41,6 +41,9 @@ GOSSIP_LAZY = 6            # IHAVE targets per heartbeat (D_lazy)
 PRUNE_BACKOFF_SECS = 60    # gossipsub v1.1 prune backoff we advertise
 MAX_IHAVE_IDS = 64         # ids honored per IHAVE control frame
 MAX_IWANT_PENDING = 4096   # outstanding gossip-promise cap
+MAX_IWANT_SERVE = 64       # messages served per inbound IWANT frame
+MAX_IWANT_RETRANSMITS = 3  # serves per (peer, mid) — gossipsub v1.1 cap
+MAX_IWANT_SERVED_TRACK = 8192  # LRU bound on the (peer, mid) serve counts
 
 ACCEPT = "accept"
 IGNORE = "ignore"
@@ -123,6 +126,8 @@ class GossipNode:
         # mcache: mid -> (topic, wire_data) for IWANT serving (mcache.rs).
         self._mcache: "OrderedDict[bytes, tuple]" = OrderedDict()
         self._iwant_pending: Set[bytes] = set()
+        # (peer, mid) -> times served in response to IWANT (LRU-bounded).
+        self._iwant_served: "OrderedDict[tuple, int]" = OrderedDict()
         self._lock = threading.RLock()
         if hasattr(transport, "register"):
             transport.register(self)
@@ -253,12 +258,29 @@ class GossipNode:
                     want.append(mid)
         if want:
             self._send_rpc(src, {"control": {"iwant": [want]}})
-        # IWANT: serve from the message cache.
+        # IWANT: serve from the message cache, budgeted (gossipsub v1.1
+        # protocol.rs max_ihave_length / IWANT retransmission caps): at
+        # most MAX_IWANT_SERVE messages per control frame, and each
+        # (peer, mid) is retransmitted at most MAX_IWANT_RETRANSMITS
+        # times — without the caps a peer could request the whole ~1024-
+        # entry mcache every frame as a bandwidth amplifier.
         serve = []
         for mids in control.get("iwant", []):
             for mid in mids:
+                if len(serve) >= MAX_IWANT_SERVE:
+                    break
+                key = (src, mid)
+                if self._iwant_served.get(key, 0) >= MAX_IWANT_RETRANSMITS:
+                    continue
                 hit = self._mcache.get(mid)
                 if hit is not None:
+                    self._iwant_served[key] = self._iwant_served.get(key, 0) + 1
+                    # True LRU: touching a counter keeps it resident, so
+                    # flooding 8k junk ids cannot evict (and reset) a hot
+                    # entry's retransmit count.
+                    self._iwant_served.move_to_end(key)
+                    while len(self._iwant_served) > MAX_IWANT_SERVED_TRACK:
+                        self._iwant_served.popitem(last=False)
                     serve.append({"topic": hit[0], "data": hit[1]})
         if serve:
             self._send_rpc(src, {"publish": serve})

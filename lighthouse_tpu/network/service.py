@@ -28,6 +28,7 @@ from lighthouse_tpu.network.types import (
     Protocol,
     Status,
     attestation_subnet_topic,
+    attester_slashing_topic,
     beacon_aggregate_and_proof_topic,
     beacon_block_topic,
     compute_subnet_for_attestation,
@@ -205,6 +206,13 @@ class NetworkService:
                 attestation_subnet_topic(subnet, fd),
                 validator=self._validate_attestation,
             )
+        self.gossip.subscribe(
+            attester_slashing_topic(fd),
+            validator=self._validate_attester_slashing,
+        )
+        # Slasher broadcast hook (slasher/service): locally-found
+        # slashings gossip out and enter peers' op pools.
+        self.chain.on_attester_slashing_found = self.publish_attester_slashing
 
     def publish_block(self, signed_block) -> int:
         return self.gossip.publish(
@@ -227,6 +235,12 @@ class NetworkService:
         data = self.chain.types.SignedAggregateAndProof.serialize(signed_aggregate)
         return self.gossip.publish(
             beacon_aggregate_and_proof_topic(self.fork_digest), data
+        )
+
+    def publish_attester_slashing(self, slashing) -> int:
+        data = self.chain.types.AttesterSlashing.serialize(slashing)
+        return self.gossip.publish(
+            attester_slashing_topic(self.fork_digest), data
         )
 
     # ------------------------------------------------------- gossip validate
@@ -288,6 +302,65 @@ class NetworkService:
             self.chain.process_attestation(att)
         except AttestationError:
             pass
+
+    def _validate_attester_slashing(self, topic: str, data: bytes,
+                                    origin: str) -> str:
+        """Gossip attester slashings: slashable pair + both signatures
+        valid against the head state -> op pool (the reference's
+        GossipVerifiedAttesterSlashing path)."""
+        chain = self.chain
+        try:
+            slashing = chain.types.AttesterSlashing.deserialize(data)
+        except Exception:
+            return REJECT
+        from lighthouse_tpu.state_transition import (
+            block_processing as bp,
+            signature_sets as sigsets,
+        )
+        from lighthouse_tpu.crypto.bls.api import verify_signature_sets
+
+        a1, a2 = slashing.attestation_1, slashing.attestation_2
+        if not bp.is_slashable_attestation_data(a1.data, a2.data):
+            return REJECT
+        # Structural indexed-attestation checks (sorted, unique, non-empty):
+        # the aggregate signature is order-independent, so without these a
+        # mutated-but-signature-valid slashing would be ACCEPTed, pooled,
+        # and later fail is_valid_indexed_attestation inside our own
+        # produced block. Same predicate the block processor runs
+        # (signatures checked separately below, in one batch).
+        state = chain.head.state  # one snapshot for ALL checks below —
+        # a concurrent head swap must not split structural vs freshness
+        # vs signature validation across different states
+        for att in (a1, a2):
+            if not bp.is_valid_indexed_attestation(
+                state, chain.types, chain.spec, att,
+                bp.VerifySignatures.FALSE, None,
+            ):
+                return REJECT
+        # Gossip spec: at least one covered validator must still be
+        # slashable — otherwise replays of applied slashings would
+        # re-propagate forever and a pooled stale op would brick our own
+        # produced blocks. Same predicate the op pool packs by (shared
+        # helper so accept => pool-keeps => packs cannot drift).
+        from lighthouse_tpu.op_pool.pool import OperationPool
+        from lighthouse_tpu.state_transition import helpers as sth
+        epoch = sth.get_current_epoch(state, chain.spec)
+        if not OperationPool.slashing_has_fresh_target(slashing, state, epoch):
+            return IGNORE
+        try:
+            sets = [
+                sigsets.indexed_attestation_signature_set(
+                    state, chain.types, chain.spec, att, chain.pubkey_getter
+                )
+                for att in (a1, a2)
+            ]
+            if not verify_signature_sets(sets, backend=chain.bls_backend):
+                return REJECT
+        except Exception:
+            return REJECT
+        if chain.op_pool is not None:
+            chain.op_pool.insert_attester_slashing(slashing)
+        return ACCEPT
 
     def _validate_aggregate(self, topic: str, data: bytes, origin: str) -> str:
         try:

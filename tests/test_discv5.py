@@ -1,0 +1,258 @@
+"""discv5 v5.1 wire protocol tests (VERDICT r3 item 3).
+
+KATs are the OFFICIAL spec test vectors (devp2p
+discv5-wire-test-vectors.md), checked in the decrypt/verify direction:
+the AES-GCM tag and the ECDSA verification cryptographically pin both
+the vectors and this implementation (a wrong AD layout, masking, or KDF
+fails the tag/signature, not just a byte comparison).
+
+Live tests run real UDP sockets on localhost — every packet on the wire
+is a spec-format discv5 packet — including a two-OS-process bootnode
+discovery exchange.
+"""
+
+import json
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from lighthouse_tpu.network import discv5 as d5
+from lighthouse_tpu.network.discovery import make_node_enr
+from lighthouse_tpu.network.enr import (
+    Enr,
+    compressed_pubkey,
+    generate_key,
+    private_key_from_bytes,
+    rlp_encode,
+)
+
+SRC_ID = bytes.fromhex(
+    "aaaa8419e9f49d0083561b48287df592939a8d19947d8c0ef88f2a4856a69fbb")
+DEST_ID = bytes.fromhex(
+    "bbbb9d047f0488c0b5a93c1c3f2d8bafc7c8ff337024a55434a0d0555de64db9")
+CHALLENGE_DATA = bytes.fromhex(
+    "000000000000000000000000000000006469736376350001010102030405060708"
+    "090a0b0c00180102030405060708090a0b0c0d0e0f100000000000000000")
+
+
+def test_spec_vector_ping_message_packet():
+    """Official 'ping message packet' vector: encode side reproduces the
+    spec bytes; decode side recovers the ping through the GCM tag."""
+    nonce = bytes.fromhex("ffffffffffffffffffffffff")
+    read_key = bytes(16)
+    iv = bytes(16)
+    ping = d5.encode_ping(b"\x00\x00\x00\x01", 2)
+    assert ping.hex() == "01c6840000000102"
+    header = d5.Header(d5.FLAG_MESSAGE, nonce, SRC_ID)
+    ct = d5.encrypt_message(read_key, nonce, ping, iv + header.encode())
+    packet = d5.encode_packet(DEST_ID, header, ct, iv)
+    assert packet.hex() == (
+        "00000000000000000000000000000000088b3d4342774649325f313964a39e55"
+        "ea96c005ad52be8c7560413a7008f16c9e6d2f43bbea8814a546b7409ce783d3"
+        "4c4f53245d08dab84102ed931f66d1492acb308fa1c6715b9d139b81acbdcc")
+
+    # Decode direction: unmask + authenticated decrypt.
+    got_header, got_msg, plain = d5.decode_header(DEST_ID, packet)
+    assert got_header.flag == d5.FLAG_MESSAGE
+    assert got_header.nonce == nonce
+    assert got_header.authdata == SRC_ID
+    pt = d5.decrypt_message(read_key, got_header.nonce, got_msg,
+                            packet[:16] + got_header.encode())
+    mtype, fields = d5.decode_message(pt)
+    assert mtype == d5.MSG_PING
+    assert bytes(fields[0]) == b"\x00\x00\x00\x01"
+    assert int.from_bytes(fields[1], "big") == 2
+
+
+def test_spec_vector_whoareyou_packet():
+    """Official WHOAREYOU vector (request-nonce 0102.., id-nonce 0102..,
+    enr-seq 0, zero masking IV)."""
+    nonce = bytes.fromhex("0102030405060708090a0b0c")
+    id_nonce = bytes.fromhex("0102030405060708090a0b0c0d0e0f10")
+    header = d5.Header(d5.FLAG_WHOAREYOU,
+                       nonce, id_nonce + (0).to_bytes(8, "big"))
+    packet = d5.encode_packet(DEST_ID, header, b"", bytes(16))
+    assert packet.hex() == (
+        "00000000000000000000000000000000088b3d434277464933a1ccc59f5967ad"
+        "1d6035f15e528627dde75cd68292f9e6c27d6b66c8100a873fcbaed4e16b8d")
+    got, msg, plain = d5.decode_header(DEST_ID, packet)
+    assert got.flag == d5.FLAG_WHOAREYOU
+    assert msg == b""
+    # challenge-data = the unmasked packet bytes; this vector's value is
+    # the spec's published challenge-data for the handshake vectors.
+    assert plain == CHALLENGE_DATA
+
+
+def test_spec_vector_key_derivation():
+    """Official ECDH + HKDF vector: compressed-point secret, salt =
+    challenge-data, info = kdf-text || ids."""
+    eph = private_key_from_bytes(bytes.fromhex(
+        "fb757dc581730490a1d7a00deea65e9b1936924caaea8f44d476014856b68736"))
+    dest_pub = bytes.fromhex(
+        "0317931e6e0840220642f230037d285d122bc59063221ef3226b1f403ddc"
+        "69ca91")
+    secret = d5.ecdh(eph, dest_pub)
+    ik, rk = d5.derive_session_keys(secret, SRC_ID, DEST_ID, CHALLENGE_DATA)
+    assert ik.hex() == "dccc82d81bd610f4f76d3ebe97a40571"
+    assert rk.hex() == "ac74bb8773749920b0d3a8881c173ec5"
+
+
+def test_spec_vector_id_signature_verifies():
+    """Official id-nonce-signing vector, verify direction (ECDSA nonces
+    are random, so signing is checked by verification, like the spec's
+    own note)."""
+    sk = private_key_from_bytes(bytes.fromhex(
+        "fb757dc581730490a1d7a00deea65e9b1936924caaea8f44d476014856b68736"))
+    eph_pub = bytes.fromhex(
+        "039961e4c2356d61bedb83052c115d311acb3a96f5777296dcf29735113026"
+        "6231")
+    sig = bytes.fromhex(
+        "94852a1e2318c4e5e9d422c98eaf19d1d90d876b29cd06ca7cb7546d0fff7b48"
+        "4fe86c09a064fe72bdbef73ba8e9c34df0cd2b53e9d65528c2c7f336d5dfc6e6")
+    assert d5.id_verify(compressed_pubkey(sk), sig, CHALLENGE_DATA,
+                        eph_pub, DEST_ID)
+    # Any bit flip dies.
+    bad = bytearray(sig)
+    bad[7] ^= 1
+    assert not d5.id_verify(compressed_pubkey(sk), bytes(bad),
+                            CHALLENGE_DATA, eph_pub, DEST_ID)
+    # Our own sign path round-trips through the same verifier.
+    ours = d5.id_sign(sk, CHALLENGE_DATA, eph_pub, DEST_ID)
+    assert d5.id_verify(compressed_pubkey(sk), ours, CHALLENGE_DATA,
+                        eph_pub, DEST_ID)
+
+
+def _mk_service(port: int = 0) -> d5.Discv5Service:
+    key = generate_key()
+    enr = make_node_enr(key, peer_id="", ip="127.0.0.1", udp=0)
+    svc = d5.Discv5Service(key, enr)
+    # Re-sign with the real bound port so peers can address us.
+    svc.local_enr = svc.local_enr.with_updates(key, udp=svc.port)
+    return svc
+
+
+def test_udp_handshake_ping_findnode():
+    """Two services over real UDP: first contact triggers WHOAREYOU ->
+    handshake -> session; PING/PONG and FINDNODE/NODES flow after."""
+    a = _mk_service().start()
+    b = _mk_service().start()
+    # Seed b's table with two extra (offline) records for NODES serving.
+    extra = [make_node_enr(generate_key(), peer_id="", ip="127.0.0.1",
+                           udp=9001 + i) for i in range(2)]
+    for e in extra:
+        b.add_enr(e)
+    try:
+        a.add_enr(b.local_enr)
+        assert a.ping(b.local_enr, timeout=5.0)
+        assert b.stats["whoareyou_sent"] == 1      # first contact challenged
+        assert a.stats["handshakes"] == 1
+        # Session established: an immediate second ping needs no handshake.
+        assert a.ping(b.local_enr, timeout=5.0)
+        assert b.stats["whoareyou_sent"] == 1
+
+        # FINDNODE over the full distance range drains b's table.
+        got = a.find_node(b.local_enr, list(range(1, 257)), timeout=5.0)
+        ids = {e.node_id for e in got}
+        for e in extra:
+            assert e.node_id in ids
+        # Distance 0 returns b's own record (spec).
+        self_rec = a.find_node(b.local_enr, [0], timeout=5.0)
+        assert [e.node_id for e in self_rec] == [b.local_enr.node_id]
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_udp_lookup_via_bootnode():
+    """Three services: c knows only the bootnode; lookup discovers a."""
+    boot = _mk_service().start()
+    a = _mk_service().start()
+    c = _mk_service().start()
+    try:
+        # a registers with the bootnode (handshake + ping).
+        a.add_enr(boot.local_enr)
+        assert a.ping(boot.local_enr, timeout=5.0)
+        boot.add_enr(a.local_enr)
+        found = c.lookup([boot.local_enr])
+        ids = {e.node_id for e in found}
+        assert a.local_enr.node_id in ids
+    finally:
+        boot.stop()
+        a.stop()
+        c.stop()
+
+
+_CHILD = r"""
+import json, sys
+from lighthouse_tpu.network import discv5 as d5
+from lighthouse_tpu.network.discovery import make_node_enr
+from lighthouse_tpu.network.enr import Enr, generate_key
+
+key = generate_key()
+enr = make_node_enr(key, peer_id="", ip="127.0.0.1", udp=0)
+svc = d5.Discv5Service(key, enr)
+svc.local_enr = svc.local_enr.with_updates(key, udp=svc.port)
+svc.start()
+print(json.dumps({"enr": svc.local_enr.to_text()}), flush=True)
+for line in sys.stdin:
+    req = json.loads(line)
+    if req["cmd"] == "ping":
+        target = Enr.from_text(req["enr"])
+        ok = svc.ping(target, timeout=5.0)
+        print(json.dumps({"ok": ok,
+                          "handshakes": svc.stats["handshakes"]}),
+              flush=True)
+    elif req["cmd"] == "lookup":
+        target = Enr.from_text(req["enr"])
+        found = svc.lookup([target])
+        print(json.dumps({"ok": True,
+                          "found": [e.to_text() for e in found]}),
+              flush=True)
+    elif req["cmd"] == "stop":
+        svc.stop()
+        print(json.dumps({"ok": True}), flush=True)
+        break
+"""
+
+
+@pytest.mark.slow
+def test_two_process_bootnode_discovery():
+    """VERDICT item 3 'Done' criterion: two OS processes exchanging
+    spec-format discv5 packets over UDP — child registers with an
+    in-test bootnode service, a second child discovers it by lookup."""
+    boot = _mk_service().start()
+    boot_text = boot.local_enr.to_text()
+
+    def spawn():
+        return subprocess.Popen(
+            [sys.executable, "-c", _CHILD], stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, cwd="/root/repo",
+        )
+
+    def rpc(p, obj):
+        p.stdin.write(json.dumps(obj) + "\n")
+        p.stdin.flush()
+        line = p.stdout.readline()
+        assert line, "child died"
+        return json.loads(line)
+
+    p1 = spawn()
+    p2 = spawn()
+    try:
+        enr1 = json.loads(p1.stdout.readline())["enr"]
+        json.loads(p2.stdout.readline())
+        out = rpc(p1, {"cmd": "ping", "enr": boot_text})
+        assert out["ok"] and out["handshakes"] >= 1
+        boot.add_enr(Enr.from_text(enr1))
+        out = rpc(p2, {"cmd": "lookup", "enr": boot_text})
+        assert enr1 in out["found"], out
+        rpc(p1, {"cmd": "stop"})
+        rpc(p2, {"cmd": "stop"})
+    finally:
+        boot.stop()
+        for p in (p1, p2):
+            p.terminate()

@@ -1,35 +1,21 @@
-"""Real-socket transport tests: wire codec, TCP dial/handshake, gossip and
-Req/Resp over actual OS sockets, UDP discovery packets (VERDICT Missing #1
-— no more SimTransport-only networking)."""
+"""libp2p transport tests: identity/peer ids, multistream-select + yamux
++ noise-with-identity-payload upgrade, gossip and Req/Resp streams over
+real OS sockets, and the multi-process socket testnet (VERDICT r3 item 4
+— the private tagged envelope is gone; every TCP byte is a libp2p wire
+format)."""
 
+import socket
 import threading
 import time
 
 import pytest
 
-from lighthouse_tpu.network.transport import (
-    TcpTransport,
-    UdpTransport,
-    decode_wire,
-    encode_wire,
-)
-
-
-def test_wire_codec_roundtrip():
-    frames = [
-        ("gossip", "/eth2/abcd/beacon_block/ssz_snappy", b"\x00" * 40,
-         b"payload", "origin-peer"),
-        ("rpc_req", 7, "/eth2/beacon_chain/req/status/1", b"\x01\x02"),
-        ("rpc_end", 123456789),
-        (None, True, False, -5, 2**70, "", b"", (), []),
-        ("nested", ("a", (1, [b"x", None])), [1, 2, [3, (4,)]]),
-    ]
-    for f in frames:
-        assert decode_wire(encode_wire(f)) == f
+from lighthouse_tpu.network import libp2p as lp
+from lighthouse_tpu.network.transport import Libp2pTransport, TcpTransport
 
 
 class _Recorder:
-    def __init__(self, peer_id):
+    def __init__(self, peer_id=""):
         self.peer_id = peer_id
         self.frames = []
         self.event = threading.Event()
@@ -48,48 +34,157 @@ def _wait(cond, timeout=5.0):
     return False
 
 
-def test_tcp_dial_handshake_and_frames():
-    ta, tb = TcpTransport(), TcpTransport()
-    a, b = _Recorder("node-a"), _Recorder("node-b")
+def test_identity_peer_ids():
+    """Ed25519 identities: stable round-trip, identity-multihash base58
+    ids with the ed25519 '12D3KooW' prefix, and pubkey-protobuf parsing."""
+    ident = lp.Identity()
+    pid = ident.peer_id
+    assert pid.startswith("12D3KooW"), pid
+    # Deterministic: same key -> same id; serialization round-trips.
+    again = lp.Identity.from_bytes(ident.to_bytes())
+    assert again.peer_id == pid
+    # The protobuf parses back to the same key.
+    pub = lp.pubkey_from_protobuf(ident.pubkey_protobuf())
+    sig = ident.sign(b"msg")
+    pub.verify(sig, b"msg")  # raises on mismatch
+    # base58 round-trip.
+    raw = b"\x00\x01\xff" * 7
+    assert lp.base58_decode(lp.base58_encode(raw)) == raw
+
+
+def test_noise_identity_payload_binding():
+    """The identity key signs the noise static key; verification fails
+    for a tampered signature or a different static key (the libp2p-noise
+    impersonation guard)."""
+    ident = lp.Identity()
+    static_pub = b"\x42" * 32
+    payload = lp.noise_payload(ident, static_pub)
+    assert lp.verify_noise_payload(payload, static_pub) == ident.peer_id
+    # Wrong static key: signature does not bind.
+    with pytest.raises(lp.Libp2pError):
+        lp.verify_noise_payload(payload, b"\x43" * 32)
+    # Tampered payload: dies.
+    bad = bytearray(payload)
+    bad[-1] ^= 1
+    with pytest.raises(lp.Libp2pError):
+        lp.verify_noise_payload(bytes(bad), static_pub)
+
+
+def test_upgrade_and_yamux_streams():
+    """Socketpair upgrade: multistream(/noise) -> XX -> multistream
+    (/yamux); peers learn each other's DERIVED ids; streams open both
+    ways with protocol negotiation, data, FIN; unknown protocols get
+    'na'."""
+    a_sock, b_sock = socket.socketpair()
+    ia, ib = lp.Identity(), lp.Identity()
+    got = {}
+    served = threading.Event()
+
+    def b_on_stream(stream):
+        proto = lp.ms_handle(stream, {"/test/echo/1"})
+        got["proto"] = proto
+        body = stream.read_until_fin()
+        stream.write(b"echo:" + body)
+        stream.close_write()
+        served.set()
+
+    def b_side():
+        got["b"] = lp.upgrade_inbound(b_sock, ib, None, b_on_stream)
+
+    tb = threading.Thread(target=b_side, daemon=True)
+    tb.start()
+    remote_from_a, mux_a = lp.upgrade_outbound(a_sock, ia, None,
+                                               lambda s: s.reset())
+    tb.join(timeout=5.0)
+    remote_from_b, mux_b = got["b"]
+    assert remote_from_a == ib.peer_id
+    assert remote_from_b == ia.peer_id
+
+    # a opens a stream, negotiates, sends, half-closes, reads the echo.
+    stream = mux_a.open_stream()
+    lp.ms_select(stream, "/test/echo/1")
+    stream.write(b"hello yamux")
+    stream.close_write()
+    assert served.wait(5.0)
+    assert got["proto"] == "/test/echo/1"
+    assert stream.read_until_fin() == b"echo:hello yamux"
+
+    # Unsupported protocol is refused with 'na'.
+    s2 = mux_a.open_stream()
+    with pytest.raises(lp.Libp2pError):
+        lp.ms_select(s2, "/test/unknown/1")
+    mux_a.goaway()
+    mux_b.goaway()
+
+
+def test_libp2p_transport_gossip_and_rpc():
+    """Two Libp2pTransports: derived ids, meshsub frames deliver, and a
+    full Req/Resp request round-trips as stream-per-request."""
+    from lighthouse_tpu.network.pubsub_pb import decode_rpc, encode_rpc
+    from lighthouse_tpu.network.types import encode_response_chunk
+
+    ta, tb = Libp2pTransport(), Libp2pTransport()
+
+    class _RpcNode(_Recorder):
+        def __init__(self, transport):
+            super().__init__(transport.peer_id)
+            self.transport = transport
+
+        def handle_frame(self, src, frame):
+            super().handle_frame(src, frame)
+            if frame[0] == "rpc_req":
+                _, req_id, protocol, body = frame
+                assert protocol == "/eth2/beacon_chain/req/status/1"
+                self.transport.send(
+                    self.peer_id, src,
+                    ("rpc_resp", req_id,
+                     encode_response_chunk(0, b"status:" + body)))
+                self.transport.send(self.peer_id, src, ("rpc_end", req_id))
+
+    a, b = _RpcNode(ta), _RpcNode(tb)
     ta.register(a)
     tb.register(b)
     try:
         remote = ta.dial(tb.listen_addr)
-        assert remote == "node-b"
-        assert _wait(lambda: "node-a" in tb.connected_peers())
-        ta.send("node-a", "node-b", ("ping", 1, b"\xaa"))
+        assert remote == tb.peer_id
+        assert remote.startswith("12D3KooW")
+        assert _wait(lambda: ta.peer_id in tb.connected_peers())
+
+        # Gossip: a protobuf RPC envelope rides the meshsub stream.
+        rpc = encode_rpc({"publish": [
+            {"topic": "/eth2/x/beacon_block/ssz_snappy", "data": b"\x01"}
+        ]})
+        ta.send(a.peer_id, b.peer_id, ("gs", rpc))
         assert b.event.wait(5.0)
-        assert b.frames == [("node-a", ("ping", 1, b"\xaa"))]
-        # And the reverse direction on the same connection.
-        tb.send("node-b", "node-a", ("pong", 2, None))
-        assert a.event.wait(5.0)
-        assert a.frames == [("node-b", ("pong", 2, None))]
-        # Unknown destination: dropped, no raise.
-        ta.send("node-a", "nobody", ("x",))
+        src, frame = b.frames[0]
+        assert src == ta.peer_id and frame[0] == "gs"
+        assert decode_rpc(frame[1])["publish"][0]["data"] == b"\x01"
+
+        # Req/Resp: request from b to a over a fresh negotiated stream.
+        done = threading.Event()
+        chunks = []
+
+        class _Collector(_RpcNode):
+            def handle_frame(self, src2, frame2):
+                if frame2[0] == "rpc_resp":
+                    chunks.append(frame2[2])
+                elif frame2[0] == "rpc_end":
+                    done.set()
+                else:
+                    super().handle_frame(src2, frame2)
+
+        collector = _Collector(tb)
+        tb.register(collector)
+        tb.send(collector.peer_id, a.peer_id,
+                ("rpc_req", 77, "/eth2/beacon_chain/req/status/1",
+                 b"\xaa\xbb"))
+        assert done.wait(5.0)
+        from lighthouse_tpu.network.types import decode_response_chunk
+        code, data, _ = decode_response_chunk(chunks[0])
+        assert code == 0 and data == b"status:\xaa\xbb"
     finally:
         ta.close()
         tb.close()
-
-
-def test_udp_discovery_packets():
-    ua, ub = UdpTransport(), UdpTransport()
-    a, b = _Recorder("disc-a"), _Recorder("disc-b")
-    ua.register(a)
-    ub.register(b)
-    try:
-        ua.add_peer("disc-b", ub.listen_addr)
-        ua.send("disc-a", "disc-b", ("ping", 42))
-        assert b.event.wait(5.0)
-        assert b.frames == [("disc-a", ("ping", 42))]
-        # The receiver learned the sender's address from the packet and can
-        # answer without prior configuration.
-        ub.send("disc-b", "disc-a", ("pong", 42))
-        assert a.event.wait(5.0)
-        assert a.frames == [("disc-a", ("pong", 42))] or \
-            a.frames == [("disc-b", ("pong", 42))]
-    finally:
-        ua.close()
-        ub.close()
 
 
 def _two_connected_nodes():
@@ -101,22 +196,24 @@ def _two_connected_nodes():
         cfg = ClientConfig(preset="minimal", n_interop_validators=16,
                            genesis_time=1_600_000_000, http_port=0,
                            bls_backend="fake", mock_el=False)
-        c = ClientBuilder(cfg).build(transport=t, peer_id=f"tcp-node-{i}")
+        c = ClientBuilder(cfg).build(transport=t, peer_id=t.peer_id)
         c.api.start()
         clients.append(c)
         transports.append(t)
     peer = clients[0].network.connect_addr(transports[1].listen_addr)
-    assert peer == "tcp-node-1"
-    assert _wait(lambda: "tcp-node-0" in transports[1].connected_peers())
+    assert peer == transports[1].peer_id
+    assert _wait(lambda: transports[0].peer_id
+                 in transports[1].connected_peers())
     for c in clients:
         c.network.gossip.heartbeat()
     return clients, transports
 
 
 def test_full_node_stack_over_tcp():
-    """Two full nodes (chain + processor + gossip + RPC) on real sockets:
-    Status handshake, VC-produced block propagating via TCP gossip,
-    BlocksByRange RPC served across the socket."""
+    """Two full nodes (chain + processor + gossip + RPC) on real libp2p
+    sockets: Status handshake, VC-produced block propagating via meshsub
+    gossip, BlocksByRange served as ssz_snappy chunks on a fresh
+    stream."""
     from lighthouse_tpu.common.eth2_client import BeaconNodeHttpClient
     from lighthouse_tpu.state_transition import genesis as gen
     from lighthouse_tpu.validator_client import (
@@ -127,14 +224,13 @@ def test_full_node_stack_over_tcp():
 
     clients, transports = _two_connected_nodes()
     c0, c1 = clients
+    id0 = transports[0].peer_id
     try:
-        # Status handshake ran over TCP during connect_addr.
         assert _wait(
-            lambda: c1.network.peer_manager.peers.get("tcp-node-0") is not None
-            and c1.network.peer_manager.peers["tcp-node-0"].status is not None
+            lambda: c1.network.peer_manager.peers.get(id0) is not None
+            and c1.network.peer_manager.peers[id0].status is not None
         )
 
-        # All validators on node 0; its VC produces slot-1 blocks + atts.
         keys = gen.generate_deterministic_keypairs(16)
         store = ValidatorStore(c0.chain.types, c0.chain.spec)
         for v, sk in enumerate(keys):
@@ -155,13 +251,12 @@ def test_full_node_stack_over_tcp():
         root = c0.chain.head.block_root
         assert _wait(lambda: (c1.processor.run_until_idle() or
                               c1.chain.head.block_root == root), 10.0), \
-            "block did not propagate over TCP gossip"
+            "block did not propagate over libp2p gossip"
 
-        # BlocksByRange over the socket (sync path).
         from lighthouse_tpu.network.types import BlocksByRangeRequest, Protocol
 
         chunks = c1.network.rpc.request(
-            "tcp-node-0", Protocol.BLOCKS_BY_RANGE,
+            id0, Protocol.BLOCKS_BY_RANGE,
             BlocksByRangeRequest(start_slot=0, count=8).to_bytes(),
         )
         assert len(chunks) >= 2
@@ -176,9 +271,9 @@ def test_full_node_stack_over_tcp():
 
 @pytest.mark.slow
 def test_three_process_testnet_finalizes():
-    """THE socket-layer integration gate (VERDICT item 5 'Done' criterion):
-    three separate OS processes on localhost — control plane over stdio,
-    blocks/attestations over TCP gossip — finalize epochs together."""
+    """THE socket-layer integration gate: three separate OS processes on
+    localhost — control plane over stdio, blocks/attestations over
+    libp2p TCP gossip — finalize epochs together."""
     import json
     import subprocess
     import sys
@@ -208,7 +303,6 @@ def test_three_process_testnet_finalizes():
             out = send(p, {"cmd": "init", "node_index": i, "n_nodes": N,
                            "n_validators": V})
             addrs.append(out["addr"])
-        # Full mesh: i dials j for i < j.
         for i in range(N):
             for j in range(i + 1, N):
                 send(procs[i], {"cmd": "connect", "addr": addrs[j]})
@@ -217,7 +311,6 @@ def test_three_process_testnet_finalizes():
         for slot in range(1, 5 * per_epoch):
             for p in procs:
                 send(p, {"cmd": "slot", "slot": slot})
-            # Let late gossip drain before the next lockstep slot.
             for p in procs:
                 send(p, {"cmd": "settle"})
 
@@ -266,8 +359,6 @@ def test_noise_handshake_vectors_and_properties():
         assert False, "tampered ciphertext must fail"
     except NoiseError:
         pass
-    # An eavesdropper with her own ephemeral cannot decrypt message 2's
-    # static key (her ee differs): the AEAD tag fails.
     eve = NoiseHandshake(initiator=True, payload=b"eve")
     eve.write_message()
     try:
@@ -275,37 +366,3 @@ def test_noise_handshake_vectors_and_properties():
         assert False, "eavesdropper must not decrypt message 2"
     except NoiseError:
         pass
-
-
-def test_tcp_noise_encrypted_transport():
-    """Full TcpTransport with secure=True: frames flow over the encrypted
-    channel; a plaintext (insecure) dialer cannot connect; the hello id
-    is bound to the noise identity."""
-    ta, tb = TcpTransport(secure=True), TcpTransport(secure=True)
-    a, b = _Recorder("enc-a"), _Recorder("enc-b")
-    ta.register(a)
-    tb.register(b)
-    tc = TcpTransport()          # plaintext transport
-    c = _Recorder("plain-c")
-    tc.register(c)
-    try:
-        remote = ta.dial(tb.listen_addr)
-        assert remote == "enc-b"
-        ta.send("enc-a", "enc-b", ("gossip", b"\x01" * 64))
-        assert b.event.wait(5.0)
-        assert b.frames == [("enc-a", ("gossip", b"\x01" * 64))]
-        tb.send("enc-b", "enc-a", ("ack",))
-        assert a.event.wait(5.0)
-
-        # A plaintext dialer cannot join an encrypted listener: its hello
-        # is not a noise message 1 the responder accepts as a handshake,
-        # and the dial errors or times out without a connection forming.
-        import pytest as _pytest
-
-        with _pytest.raises((ConnectionError, OSError, ValueError)):
-            tc.dial(tb.listen_addr, timeout=2.0)
-        assert "plain-c" not in tb.connected_peers()
-    finally:
-        ta.close()
-        tb.close()
-        tc.close()
