@@ -216,6 +216,24 @@ def compute_block_rewards(chain, start_slot: int, end_slot: int) -> List[dict]:
                                       block.body.sync_aggregate,
                                       VerifySignatures.FALSE, None)
 
+        # Drift guard: the phased inline sequence above must stay
+        # bit-identical with per_block_processing — if a future fork adds
+        # an operation it lacks, every later block's attribution in the
+        # range silently corrupts. Fail loudly instead. Checked on the
+        # LAST block only: a full-state Merkleization per block would
+        # dwarf the replay at large registries, and any drift poisons
+        # every subsequent root, so the final root catches it.
+        if root == seg[-1][0]:
+            got_root = t.BeaconState[fork].hash_tree_root(state)
+            if got_root != bytes(block.state_root):
+                raise AnalysisError(
+                    f"replay drift detected by slot {int(block.slot)}: "
+                    f"post-state root {got_root.hex()} != block.state_root "
+                    f"{bytes(block.state_root).hex()} — the inline "
+                    "operation sequence no longer matches "
+                    "per_block_processing"
+                )
+
         att_reward = b3 - b2
         out.append({
             "block_root": "0x" + root.hex(),

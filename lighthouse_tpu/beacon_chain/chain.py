@@ -24,9 +24,12 @@ Ordering: `_lock` before `_fc_lock`; never the reverse.
 
 from __future__ import annotations
 
+import logging
 import threading
 from dataclasses import dataclass
 from typing import List, Optional
+
+logger = logging.getLogger(__name__)
 
 from lighthouse_tpu.common.slot_clock import ManualSlotClock, SlotClock
 from lighthouse_tpu.execution_layer.execution_layer import normalize_lvh
@@ -89,6 +92,12 @@ class BeaconChain:
         self.op_pool = op_pool
         self.deposit_cache = deposit_cache  # eth1 follower (deposits)
         self.da_checker = da_checker        # deneb blob availability
+        # Optional slasher attach (reference slasher/service + client/src/
+        # builder.rs:150): verified attestations stream in; found double/
+        # surround votes drain into the op pool and out through the
+        # broadcast callback (NetworkService sets it to gossip-publish).
+        self.slasher_service = None
+        self.on_attester_slashing_found = None
         self._lock = threading.RLock()      # import lock (module docstring)
         self._fc_lock = threading.RLock()   # fork-choice lock
 
@@ -399,6 +408,7 @@ class BeaconChain:
             self, attestation, subnet_id
         )
         self.apply_attestation_to_fork_choice(verified.indexed_attestation)
+        self._feed_slasher(verified.indexed_attestation)
         if self.op_pool is not None:
             self.op_pool.insert_attestation(attestation, verified.indexed_attestation)
         return verified
@@ -410,6 +420,7 @@ class BeaconChain:
         for r in results:
             if isinstance(r, att_ver.VerifiedUnaggregatedAttestation):
                 self.apply_attestation_to_fork_choice(r.indexed_attestation)
+                self._feed_slasher(r.indexed_attestation)
                 if self.op_pool is not None:
                     self.op_pool.insert_attestation(
                         r.attestation, r.indexed_attestation
@@ -419,12 +430,32 @@ class BeaconChain:
     def process_aggregate(self, signed_aggregate):
         verified = att_ver.verify_aggregated_attestation(self, signed_aggregate)
         self.apply_attestation_to_fork_choice(verified.indexed_attestation)
+        self._feed_slasher(verified.indexed_attestation)
         if self.op_pool is not None:
             self.op_pool.insert_attestation(
                 verified.signed_aggregate.message.aggregate,
                 verified.indexed_attestation,
             )
         return verified
+
+    def _feed_slasher(self, indexed_att) -> None:
+        """Stream a verified indexed attestation through the attached
+        slasher; found slashings enter the op pool and broadcast
+        (slasher/service/src/lib.rs shape). A slasher fault must never
+        block attestation import."""
+        svc = self.slasher_service
+        if svc is None:
+            return
+        try:
+            if svc.on_attestation(indexed_att):
+                for slashing in svc.drain_slashings():
+                    if self.op_pool is not None:
+                        self.op_pool.insert_attester_slashing(slashing)
+                    cb = self.on_attester_slashing_found
+                    if cb is not None:
+                        cb(slashing)
+        except Exception:
+            logger.exception("slasher ingest failed")
 
     def process_rpc_blobs(self, block_root: bytes, sidecars) -> list:
         """RPC-fetched sidecars (BlobsByRange/BlobsByRoot responses): ONE

@@ -44,6 +44,8 @@ def cmd_bn(args) -> int:
         engine_url=args.engine_url,
         jwt_secret=bytes.fromhex(args.jwt_secret) if args.jwt_secret else None,
         real_clock=True,
+        slasher=args.slasher,
+        slasher_dir=args.slasher_dir,
     )
     if args.bls_backend == "tpu":
         # Background-compile the production bucket grid at startup so the
@@ -306,6 +308,31 @@ def cmd_mock_el(args) -> int:
         return 0
 
 
+def cmd_boot_node(args) -> int:
+    """Standalone discv5 UDP boot node (reference boot_node/ binary):
+    serves signed ENRs to spec-format FINDNODE queries over real discv5
+    v5.1 packets (network/discv5.py)."""
+    from lighthouse_tpu.network.discovery import make_node_enr
+    from lighthouse_tpu.network.discv5 import Discv5Service
+    from lighthouse_tpu.network.enr import Enr, generate_key
+
+    key = generate_key()
+    enr = make_node_enr(key, peer_id="", ip=args.ip, udp=0)
+    svc = Discv5Service(key, enr, bind=(args.ip, args.port))
+    svc.local_enr = svc.local_enr.with_updates(key, udp=svc.port)
+    for text in args.enr or []:
+        svc.add_enr(Enr.from_text(text))
+    svc.start()
+    print(json.dumps({"enr": svc.local_enr.to_text(),
+                      "udp": svc.port}), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        svc.stop()
+        return 0
+
+
 def cmd_generate_enr(args) -> int:
     """lcli ENR tooling: build + print a real EIP-778 record (signed RLP,
     `enr:` base64url text — interoperable with any discv5 tooling)."""
@@ -345,6 +372,10 @@ def build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--bls-backend", choices=["oracle", "tpu"])
     bn.add_argument("--engine-url")
     bn.add_argument("--jwt-secret")
+    bn.add_argument("--slasher", action="store_true",
+                    help="attach the slasher (reference --slasher)")
+    bn.add_argument("--slasher-dir",
+                    help="disk backend for the slasher database")
     bn.set_defaults(fn=cmd_bn)
 
     vc = sub.add_parser("vc", help="run a validator client")
@@ -414,6 +445,13 @@ def build_parser() -> argparse.ArgumentParser:
     ge.add_argument("peer_id")
     ge.add_argument("--attnets", help="comma-separated subnet ids")
     ge.set_defaults(fn=cmd_generate_enr)
+
+    bn = sub.add_parser("boot-node",
+                        help="run a standalone discv5 UDP boot node")
+    bn.add_argument("--ip", default="127.0.0.1")
+    bn.add_argument("--port", type=int, default=0)
+    bn.add_argument("--enr", nargs="*", help="seed records (enr: text)")
+    bn.set_defaults(fn=cmd_boot_node)
     return p
 
 

@@ -66,6 +66,11 @@ class ClientConfig:
     # production batches without mid-slot cold compiles. None = off
     # (tests / CPU-only); the bn CLI enables the default grid.
     warm_device_shapes: Optional[tuple] = None
+    # Slasher attach (reference --slasher, client/src/builder.rs:150):
+    # verified attestations feed the 2D min/max-target engine; found
+    # slashings enter the op pool and gossip out.
+    slasher: bool = False
+    slasher_dir: Optional[str] = None        # None => in-memory backend
 
 
 class Client:
@@ -305,6 +310,18 @@ class ClientBuilder:
                 genesis_state.genesis_time, spec.seconds_per_slot
             )
         op_pool.restore(store)
+
+        # --- slasher attach (builder.rs:150 slasher service) --------------
+        if cfg.slasher:
+            from lighthouse_tpu.slasher.slasher import Slasher, SlasherService
+
+            n_vals = len(genesis_state.validators)
+            if cfg.slasher_dir:
+                slasher = Slasher.open(cfg.slasher_dir, types,
+                                       n_validators=n_vals)
+            else:
+                slasher = Slasher(n_validators=n_vals)
+            chain.slasher_service = SlasherService(slasher, types)
 
         # Device-backed verification amortizes far past the reference's
         # 64-item gossip cap: drive the batch former by the compiled

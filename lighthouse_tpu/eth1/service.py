@@ -61,3 +61,84 @@ class Eth1Service:
                 self.cache.insert_deposit(dep)
                 self._last_block = max(self._last_block, bn)
             return len(deposits)
+
+
+class Eth1GenesisService:
+    """Drive GENESIS from the deposit-contract log stream (reference
+    beacon_node/genesis/src/eth1_genesis_service.rs): poll the follower,
+    and after every update attempt an eth1-genesis build on the latest
+    followed block; `wait_for_genesis` loops until the spec trigger
+    (enough time + enough max-balance validators) fires."""
+
+    def __init__(self, eth1: Eth1Service, types, spec, fork=None):
+        self.eth1 = eth1
+        self.types = types
+        self.spec = spec
+        self.fork = fork
+        self._last_frontier = None   # (n_blocks, n_deposits) of last build
+        self._scan_from = 0          # first candidate block not yet ruled out
+
+    def try_genesis(self):
+        """One attempt: returns the valid genesis BeaconState or None.
+
+        Scans candidate blocks IN ORDER and builds genesis at the FIRST
+        block whose state satisfies the trigger (the reference service's
+        scan_new_blocks): building at the cache frontier instead would
+        make two honest nodes that polled at different times derive
+        different genesis states for the same chain. Cheap prefilters
+        (timestamp, deposit count) bound the expensive full replays, and
+        already-scanned blocks are skipped across attempts."""
+        from lighthouse_tpu.state_transition import genesis as gen
+
+        cache = self.eth1.cache
+        if not cache.blocks or cache.deposit_count() == 0:
+            return None
+        frontier = (len(cache.blocks), cache.deposit_count())
+        if frontier == self._last_frontier:
+            return None
+        self._last_frontier = frontier
+        kwargs = {}
+        if self.fork is not None:
+            kwargs["fork"] = self.fork
+        spec = self.spec
+        blocks = cache.blocks
+        for idx in range(self._scan_from, len(blocks)):
+            blk = blocks[idx]
+            # A candidate's verdict is immutable once its deposit snapshot
+            # is known: advance the scan pointer past definitive failures
+            # so each block's (expensive) replay happens at most once.
+            n_dep = blk.deposit_count
+            definitive = n_dep is not None
+            if n_dep is None and blk is blocks[-1]:
+                n_dep = cache.deposit_count()   # frontier may still grow
+            # Trigger preconditions that don't need a state: enough time
+            # and at least as many deposits as required validators.
+            if blk.timestamp + spec.genesis_delay >= spec.min_genesis_time \
+                    and n_dep is not None \
+                    and n_dep >= spec.min_genesis_active_validator_count:
+                state = gen.eth1_genesis_state(
+                    self.types, spec, blk.hash, blk.timestamp, cache,
+                    deposit_count=n_dep, **kwargs
+                )
+                if gen.is_valid_genesis_state(state, spec):
+                    return state
+            if definitive and self._scan_from == idx:
+                self._scan_from = idx + 1
+        return None
+
+    def wait_for_genesis(self, max_polls: int = 1_000_000,
+                         poll_interval: float = 0.0):
+        """Poll-until-genesis (the service's `wait_for_genesis` future):
+        each round ingests new logs then retries the build. Production
+        callers pass a positive `poll_interval` (the reference sleeps
+        update_interval between polls); tests drive it synchronously."""
+        import time as _time
+
+        for _ in range(max_polls):
+            self.eth1.update()
+            state = self.try_genesis()
+            if state is not None:
+                return state
+            if poll_interval > 0:
+                _time.sleep(poll_interval)
+        return None

@@ -30,7 +30,7 @@ class OperationPool:
         self._attestations: Dict[bytes, List[Tuple[tuple, object]]] = {}
         self._att_data: Dict[bytes, object] = {}
         self._proposer_slashings: Dict[int, object] = {}   # proposer idx -> op
-        self._attester_slashings: List[object] = []
+        self._attester_slashings: Dict[bytes, object] = {}  # htr -> op
         self._exits: Dict[int, object] = {}                # validator idx -> op
         self._bls_changes: Dict[int, object] = {}
 
@@ -143,8 +143,32 @@ class OperationPool:
             self._proposer_slashings.setdefault(idx, slashing)
 
     def insert_attester_slashing(self, slashing) -> None:
+        root = self.types.AttesterSlashing.hash_tree_root(slashing)
         with self._lock:
-            self._attester_slashings.append(slashing)
+            self._attester_slashings.setdefault(root, slashing)
+
+    @staticmethod
+    def slashing_fresh_targets(slashing, state, epoch: int) -> set:
+        """Validators covered by both attestations that are still slashable
+        at `epoch` — process_attester_slashing requires slashing at least
+        one, so packing an op with none makes the block invalid (the
+        reference's get_slashable_indices freshness filter). Must mirror
+        the `is_slashable_validator` predicate the processor uses:
+        merely-unslashed is NOT enough (a covered validator past its
+        withdrawable_epoch can never be slashed, so `slashed` alone would
+        treat such an op as fresh forever). Shared with the gossip
+        validator (network/service.py) so the two sites cannot drift."""
+        both = set(int(i) for i in slashing.attestation_1.attesting_indices) \
+            & set(int(i) for i in slashing.attestation_2.attesting_indices)
+        return {
+            i for i in both
+            if i < len(state.validators)
+            and h.is_slashable_validator(state.validators[i], epoch)
+        }
+
+    @classmethod
+    def slashing_has_fresh_target(cls, slashing, state, epoch: int) -> bool:
+        return bool(cls.slashing_fresh_targets(slashing, state, epoch))
 
     def insert_voluntary_exit(self, signed_exit) -> None:
         with self._lock:
@@ -166,7 +190,26 @@ class OperationPool:
                 if idx < len(state.validators)
                 and not state.validators[idx].slashed
             ][: P.MAX_PROPOSER_SLASHINGS]
-            attester = self._attester_slashings[: P.MAX_ATTESTER_SLASHINGS]
+            # Drop slashings with no slashable covered validator left
+            # (slashed / past withdrawable_epoch are both monotone), and
+            # never pack one: re-packing bricks block production. Packing
+            # also requires DISJOINT fresh coverage within the block:
+            # applying op A slashes its targets, so a second op whose
+            # fresh targets are a subset of A's (e.g. the same pair with
+            # attestation_1/2 swapped — different root, same coverage)
+            # would slash no one and invalidate our own block.
+            stale, attester, packed_cover = [], [], set()
+            for root, s in self._attester_slashings.items():
+                targets = self.slashing_fresh_targets(s, state, epoch)
+                if not targets:
+                    stale.append(root)
+                    continue
+                if len(attester) < P.MAX_ATTESTER_SLASHINGS \
+                        and not targets <= packed_cover:
+                    attester.append(s)
+                    packed_cover |= targets
+            for root in stale:
+                self._attester_slashings.pop(root, None)
             exits = [
                 e for idx, e in self._exits.items()
                 if idx < len(state.validators)

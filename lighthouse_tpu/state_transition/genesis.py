@@ -125,3 +125,146 @@ def _validators_root(types, spec, state) -> bytes:
 
     vals_t = ssz.List(types.Validator, spec.preset.VALIDATOR_REGISTRY_LIMIT)
     return vals_t.hash_tree_root(state.validators)
+
+
+# ---------------------------------------------------------------------------
+# Eth1-driven genesis (reference beacon_node/genesis/src/
+# eth1_genesis_service.rs + spec initialize_beacon_state_from_eth1)
+# ---------------------------------------------------------------------------
+
+
+def eth1_genesis_state(
+    types, spec, eth1_block_hash: bytes, eth1_timestamp: int,
+    deposit_cache, fork: str = ForkName.CAPELLA,
+    execution_block_hash: bytes = None,
+    deposit_count: int = None,
+):
+    """initialize_beacon_state_from_eth1: build genesis from the deposit-
+    contract log stream (the cache's incremental tree), replaying every
+    deposit through the REAL process_deposit — per-deposit merkle proofs
+    verified against the progressive tree root, invalid proofs-of-
+    possession skipped, top-ups accumulated — then activating validators
+    at max effective balance. Built directly at `fork` the way
+    interop_genesis_state is (the reference builds phase0 then upgrades;
+    same resulting state fields for a genesis-scheduled fork)."""
+    from . import block_processing as bp
+
+    P = spec.preset
+    state = types.BeaconState[fork]()
+    state.genesis_time = eth1_timestamp + spec.genesis_delay
+    state.slot = 0
+    state.fork = types.Fork(
+        previous_version=spec.fork_version_for_name(fork),
+        current_version=spec.fork_version_for_name(fork),
+        epoch=GENESIS_EPOCH,
+    )
+    # `deposit_count` limits the replay to the deposits included up to
+    # the CANDIDATE eth1 block (the reference replays per candidate, not
+    # per cache frontier) so every node derives the same state for the
+    # same triggering block regardless of how far its follower has read.
+    n = deposit_count if deposit_count is not None \
+        else deposit_cache.deposit_count()
+    state.eth1_data = types.Eth1Data(
+        deposit_root=deposit_cache.tree.root_at_count(n),
+        deposit_count=n,
+        block_hash=eth1_block_hash,
+    )
+    state.randao_mixes = [eth1_block_hash] * P.EPOCHS_PER_HISTORICAL_VECTOR
+    state.slashings = [0] * P.EPOCHS_PER_SLASHINGS_VECTOR
+    state.block_roots = [b"\x00" * 32] * P.SLOTS_PER_HISTORICAL_ROOT
+    state.state_roots = [b"\x00" * 32] * P.SLOTS_PER_HISTORICAL_ROOT
+
+    # Process deposits against PROGRESSIVE tree snapshots (the spec's
+    # `state.eth1_data.deposit_root = hash_tree_root(deposits[:i+1])`
+    # loop — proofs come from the incremental tree at count i+1).
+    for i in range(n):
+        dep_data, proof = deposit_cache.get_deposits(
+            i, i + 1, deposit_count=i + 1)[0]
+        state.eth1_data.deposit_root = \
+            deposit_cache.tree.root_at_count(i + 1)
+        deposit = types.Deposit(proof=proof, data=dep_data)
+        bp.process_deposit(state, types, spec, deposit, fork)
+    state.eth1_data.deposit_root = deposit_cache.tree.root_at_count(n)
+
+    # Spec initialize_beacon_state_from_eth1: recompute EVERY validator's
+    # effective balance from its final (top-up-inclusive) balance before
+    # the activation check — per-block process_deposit top-ups only add
+    # balance, so without this a validator funded across several deposits
+    # keeps its stale first-deposit effective balance and never activates
+    # (a permanent genesis divergence from spec-conformant clients).
+    inc = spec.effective_balance_increment
+    for i, v in enumerate(state.validators):
+        bal = int(state.balances[i])
+        v.effective_balance = min(
+            bal - bal % inc, spec.max_effective_balance)
+        if int(v.effective_balance) == spec.max_effective_balance:
+            v.activation_eligibility_epoch = GENESIS_EPOCH
+            v.activation_epoch = GENESIS_EPOCH
+
+    state.genesis_validators_root = _validators_root(types, spec, state)
+
+    body_cls = types.BeaconBlockBody[fork]
+    state.latest_block_header = types.BeaconBlockHeader(
+        slot=0,
+        proposer_index=0,
+        parent_root=b"\x00" * 32,
+        state_root=b"\x00" * 32,
+        body_root=body_cls.hash_tree_root(body_cls()),
+    )
+
+    if ForkName.ge(fork, ForkName.ALTAIR):
+        from . import epoch_processing as ep
+
+        state.current_sync_committee = ep.get_next_sync_committee(
+            state, types, spec)
+        state.next_sync_committee = ep.get_next_sync_committee(
+            state, types, spec)
+
+    if ForkName.ge(fork, ForkName.BELLATRIX):
+        header_cls = {
+            ForkName.BELLATRIX: types.ExecutionPayloadHeaderBellatrix,
+            ForkName.CAPELLA: types.ExecutionPayloadHeaderCapella,
+            ForkName.DENEB: types.ExecutionPayloadHeaderDeneb,
+        }[fork]
+        state.latest_execution_payload_header = header_cls(
+            block_hash=execution_block_hash or eth1_block_hash,
+            timestamp=state.genesis_time,
+            prev_randao=eth1_block_hash,
+        )
+    return state
+
+
+def is_valid_genesis_state(state, spec) -> bool:
+    """Spec trigger condition: enough time and enough active validators."""
+    from . import helpers as h
+
+    if int(state.genesis_time) < spec.min_genesis_time:
+        return False
+    active = len(h.get_active_validator_indices(state, GENESIS_EPOCH))
+    return active >= spec.min_genesis_active_validator_count
+
+
+def signed_deposit_data(types, spec, sk: SecretKey, amount: int):
+    """A correctly proof-of-possession-signed DepositData (deposit-
+    contract log payload) for tests and tooling."""
+    from lighthouse_tpu.types.spec import (
+        DOMAIN_DEPOSIT,
+        compute_domain,
+        compute_signing_root,
+    )
+
+    pk = sk.public_key().to_bytes()
+    msg = types.DepositMessage(
+        pubkey=pk,
+        withdrawal_credentials=bls_withdrawal_credentials(pk),
+        amount=amount,
+    )
+    domain = compute_domain(DOMAIN_DEPOSIT, spec.genesis_fork_version,
+                            b"\x00" * 32)
+    root = compute_signing_root(msg, types.DepositMessage, domain)
+    return types.DepositData(
+        pubkey=pk,
+        withdrawal_credentials=msg.withdrawal_credentials,
+        amount=amount,
+        signature=sk.sign(root).to_bytes(),
+    )

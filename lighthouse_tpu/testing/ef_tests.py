@@ -201,8 +201,153 @@ class BlsBatchVerifyHandler(Handler):
                 ],
                 message=bytes.fromhex(s["message"][2:]),
             ))
+        if _fake_crypto_skip(meta):
+            return
         got = bls.verify_signature_sets(sets)
         assert got == meta["output"], f"batch: {got} != {meta['output']}"
+
+
+def _fake_crypto_skip(meta: dict) -> bool:
+    """The reference's fake_crypto feature excludes cases whose outcome
+    depends on real signature validity (Makefile:141-147 matrix); vectors
+    mark those with requires_real_crypto. Files are already read (the
+    completeness check still covers them) — only the assertion is
+    skipped."""
+    from lighthouse_tpu.crypto.bls import api as bls_api
+
+    return bls_api.get_backend() == "fake" and \
+        bool(meta.get("requires_real_crypto"))
+
+
+class BlsSignHandler(Handler):
+    """bls/sign (spec sign cases): secret key + message -> signature."""
+
+    runner, name = "bls", "sign"
+
+    def run_case(self, case_dir, tracker):
+        from lighthouse_tpu.crypto.bls import api as bls
+
+        meta = tracker.read_json(os.path.join(case_dir, "meta.json"))
+        inp = meta["input"]
+        sk = bls.SecretKey(int(inp["privkey"][2:], 16))
+        got = sk.sign(bytes.fromhex(inp["message"][2:])).to_bytes()
+        assert "0x" + got.hex() == meta["output"], "sign mismatch"
+
+
+class BlsAggregateHandler(Handler):
+    """bls/aggregate: list of signatures -> aggregate (None for the
+    empty list, matching the spec's `aggregate([]) -> error`)."""
+
+    runner, name = "bls", "aggregate"
+
+    def run_case(self, case_dir, tracker):
+        from lighthouse_tpu.crypto.bls import api as bls
+
+        meta = tracker.read_json(os.path.join(case_dir, "meta.json"))
+        sigs_hex = meta["input"]
+        try:
+            sigs = [bls.Signature.from_bytes(bytes.fromhex(s[2:]))
+                    for s in sigs_hex]
+            if not sigs:
+                raise bls.BlsError("empty aggregate")
+            got = "0x" + bls.AggregateSignature.aggregate(
+                sigs).to_bytes().hex()
+        except Exception:
+            got = None
+        assert got == meta["output"], f"aggregate: {got}"
+
+
+class BlsDeserializationHandler(Handler):
+    """bls/deserialization_G1|G2 (spec milagro deserialization suites):
+    byte strings that must round-trip as valid points — or be rejected
+    (bad length, non-canonical flags, off-curve x, out-of-subgroup
+    points, infinity pubkeys)."""
+
+    runner = "bls"
+
+    def __init__(self, group: str):
+        self.name = f"deserialization_{group}"
+        self.group = group
+
+    def run_case(self, case_dir, tracker):
+        from lighthouse_tpu.crypto.bls import api as bls
+
+        meta = tracker.read_json(os.path.join(case_dir, "meta.json"))
+        raw = bytes.fromhex(meta["input"][2:])
+        try:
+            if self.group == "G1":
+                bls.PublicKey.from_bytes(raw)      # includes key_validate
+            else:
+                bls.Signature.from_bytes(raw)      # includes subgroup check
+            got = True
+        except Exception:
+            got = False
+        assert got == meta["output"], \
+            f"{self.name}: {got} != {meta['output']}"
+
+
+class KzgHandler(Handler):
+    """kzg/* (c-kzg case families the reference runs through its kzg
+    crate): blob commitments, proofs, single + batch verification."""
+
+    runner = "kzg"
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def run_case(self, case_dir, tracker):
+        from lighthouse_tpu.crypto.kzg import Kzg
+
+        kzg = Kzg.load_trusted_setup()
+        meta = tracker.read_json(os.path.join(case_dir, "meta.json"))
+
+        def blob(fn="blob.bin"):
+            return tracker.read(os.path.join(case_dir, fn))
+
+        def pt(h):
+            return None if h is None else bytes.fromhex(h[2:])
+
+        from lighthouse_tpu.crypto.bls import curves as _cv
+
+        if self.name == "blob_to_kzg_commitment":
+            got = _cv.g1_to_compressed(kzg.blob_to_kzg_commitment(blob()))
+            assert "0x" + got.hex() == meta["output"]
+        elif self.name == "compute_kzg_proof":
+            z = int(meta["input"]["z"][2:], 16)
+            proof, y = kzg.compute_kzg_proof(blob(), z)
+            assert "0x" + _cv.g1_to_compressed(proof).hex() == \
+                meta["output"]["proof"]
+            assert y == int(meta["output"]["y"][2:], 16)
+        elif self.name == "verify_kzg_proof":
+            inp = meta["input"]
+            try:
+                got = kzg.verify_kzg_proof(
+                    _cv.g1_from_compressed(pt(inp["commitment"])),
+                    int(inp["z"][2:], 16), int(inp["y"][2:], 16),
+                    _cv.g1_from_compressed(pt(inp["proof"])),
+                )
+            except Exception:
+                got = False
+            assert got == meta["output"]
+        elif self.name == "verify_blob_kzg_proof_batch":
+            n = meta["count"]
+            blobs = [blob(f"blob_{i}.bin") for i in range(n)]
+            try:
+                commitments = [
+                    _cv.g1_from_compressed(pt(c))
+                    for c in meta["input"]["commitments"]
+                ]
+                proofs = [
+                    _cv.g1_from_compressed(pt(p))
+                    for p in meta["input"]["proofs"]
+                ]
+                got = kzg.verify_blob_kzg_proof_batch(
+                    blobs, commitments, proofs)
+            except Exception:
+                got = False
+            assert got == meta["output"]
+        else:
+            raise AssertionError(f"unknown kzg handler {self.name}")
 
 
 # ---------------------------------------------------------------------------
@@ -300,12 +445,19 @@ class SanityBlocksHandler(Handler):
         state = scls.deserialize(
             tracker.read(os.path.join(case_dir, "pre.ssz"))
         )
+        block_bytes = [
+            tracker.read(os.path.join(case_dir, f"blocks_{i}.ssz"))
+            for i in range(meta["blocks_count"])
+        ]
+        if _fake_crypto_skip(meta):
+            post_p = os.path.join(case_dir, "post.ssz")
+            if os.path.exists(post_p):
+                tracker.read(post_p)   # completeness: files still covered
+            return
         ok = True
         try:
-            for i in range(meta["blocks_count"]):
-                blk = types.SignedBeaconBlock[ctx["fork"]].deserialize(
-                    tracker.read(os.path.join(case_dir, f"blocks_{i}.ssz"))
-                )
+            for raw in block_bytes:
+                blk = types.SignedBeaconBlock[ctx["fork"]].deserialize(raw)
                 state = sp.process_slots(state, types, spec, blk.message.slot)
                 bp.per_block_processing(
                     state, types, spec, blk, ctx["fork"],
@@ -377,6 +529,11 @@ class OperationsHandler(Handler):
         op_bytes = tracker.read(
             os.path.join(case_dir, f"{self.name}.ssz")
         )
+        if _fake_crypto_skip(meta):
+            post_p = os.path.join(case_dir, "post.ssz")
+            if os.path.exists(post_p):
+                tracker.read(post_p)   # completeness: files still covered
+            return
         ok = True
         try:
             _apply_operation(self.name, state, types, spec, ctx["fork"],
@@ -645,6 +802,14 @@ def default_handlers() -> List[Handler]:
         BlsAggregateVerifyHandler(),
         BlsFastAggregateVerifyHandler(),
         BlsBatchVerifyHandler(),
+        BlsSignHandler(),
+        BlsAggregateHandler(),
+        BlsDeserializationHandler("G1"),
+        BlsDeserializationHandler("G2"),
+        KzgHandler("blob_to_kzg_commitment"),
+        KzgHandler("compute_kzg_proof"),
+        KzgHandler("verify_kzg_proof"),
+        KzgHandler("verify_blob_kzg_proof_batch"),
         SszStaticHandler(),
         SszStaticHandler("defaults"),
         ShufflingHandler(),
@@ -666,11 +831,31 @@ def default_handlers() -> List[Handler]:
     ]
 
 
-def run_all(root: str = VECTOR_ROOT) -> Dict[str, int]:
-    """Run every handler over the vector tree and assert completeness."""
+def run_all(root: str = VECTOR_ROOT, bls_backend: str = None,
+            runners=None) -> Dict[str, int]:
+    """Run every handler over the vector tree and assert completeness.
+
+    `bls_backend` pins the active BLS backend for the whole run — the
+    reference runs its spec-test matrix three times (blst / fake /
+    milagro, Makefile:141-147); the analog trio here is tpu-jax /
+    cpu-native / fake, with the pure-Python oracle as the default.
+    `runners` restricts to a set of runner names (the device-backend
+    lane runs just the crypto-routing runners; the completeness check
+    only applies to full runs)."""
+    from lighthouse_tpu.crypto.bls import api as bls_api
+
     tracker = AccessTracker(root)
     counts = {}
-    for handler in default_handlers():
-        counts[f"{handler.runner}/{handler.name}"] = handler.run(tracker)
-    tracker.assert_all_accessed()
+    prev = bls_api.get_backend()
+    if bls_backend is not None:
+        bls_api.set_backend(bls_backend)
+    try:
+        for handler in default_handlers():
+            if runners is not None and handler.runner not in runners:
+                continue
+            counts[f"{handler.runner}/{handler.name}"] = handler.run(tracker)
+    finally:
+        bls_api.set_backend(prev)
+    if runners is None:
+        tracker.assert_all_accessed()
     return counts

@@ -72,7 +72,7 @@ def main() -> None:
                     mock_el=False,
                 )
                 client = ClientBuilder(cfg).build(
-                    transport=transport, peer_id=f"proc-node-{i}"
+                    transport=transport, peer_id=transport.peer_id
                 )
                 client.api.start()
                 keys = genesis_mod.generate_deterministic_keypairs(

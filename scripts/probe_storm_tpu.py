@@ -1,0 +1,103 @@
+"""Chip-scale Capella storm probe (eval config #5 twin of
+tests/test_capella_storm.py): mixed-size batches of sync-committee
+message sets + BLS-to-execution-change sets + sync contributions through
+the beacon processor's real queues, with DEVICE KZG blob verification
+interleaved between signature batches.
+
+Usage: python scripts/probe_storm_tpu.py [n_sync n_changes n_blobs]
+Prints one JSON line with per-family throughput + end-to-end storm time
+(recorded in NOTES_TPU_PERF.md).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    n_sync = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    n_changes = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    n_blobs = int(sys.argv[3]) if len(sys.argv) > 3 else 6
+
+    from lighthouse_tpu.beacon_processor import BeaconProcessor, WorkEvent
+    from lighthouse_tpu.beacon_processor.processor import AdaptiveBatchPolicy
+    from lighthouse_tpu.crypto import kzg as kzg_mod
+    from lighthouse_tpu.crypto.bls import api as bls
+    from lighthouse_tpu.testing.harness import BeaconChainHarness
+    from tests.test_capella_storm import build_storm
+
+    rig = BeaconChainHarness(n_validators=64, bls_backend="tpu")
+    rig.extend_chain(2)
+    kzg = kzg_mod.Kzg.load_trusted_setup()
+
+    print("building storm inputs...", file=sys.stderr)
+    sync_sets, change_sets, contrib_sets = build_storm(
+        rig, n_sync, n_changes)
+    blobs, commitments, proofs = [], [], []
+    for i in range(n_blobs):
+        blob = bytes([i + 1, 0, 0, 0]) * (4096 * 8)
+        c = kzg.blob_to_kzg_commitment(blob)
+        p = kzg.compute_blob_kzg_proof(blob, c)
+        blobs.append(blob)
+        commitments.append(c)
+        proofs.append(p)
+
+    counts = {"sync": 0, "change": 0, "contrib": 0, "kzg": 0}
+    batch_sizes = []
+
+    proc = BeaconProcessor(
+        batch_policy=AdaptiveBatchPolicy(max_bucket=4096,
+                                         warm=(64, 256, 1024)))
+
+    def batch_verify(kind):
+        def run(sets):
+            batch_sizes.append(len(sets))
+            assert bls.verify_signature_sets(sets, backend="tpu")
+            counts[kind] += len(sets)
+        return run
+
+    def one_verify(kind):
+        def run(s):
+            assert bls.verify_signature_sets([s], backend="tpu")
+            counts[kind] += 1
+        return run
+
+    def kzg_work(_):
+        assert kzg.verify_blob_kzg_proof_batch(
+            blobs, commitments, proofs, device=True)
+        counts["kzg"] += len(blobs)
+
+    t0 = time.monotonic()
+    for i, s in enumerate(change_sets):
+        proc.send(WorkEvent("gossip_bls_to_execution_change", s,
+                            process_individual=one_verify("change"),
+                            process_batch=batch_verify("change")))
+    for i, s in enumerate(sync_sets):
+        proc.send(WorkEvent("gossip_sync_signature", s,
+                            process_individual=one_verify("sync"),
+                            process_batch=batch_verify("sync")))
+        if i % 128 == 0:
+            proc.send(WorkEvent("api_request", None,
+                                process_individual=kzg_work))
+    for s in contrib_sets:
+        proc.send(WorkEvent("gossip_sync_contribution", s,
+                            process_individual=one_verify("contrib")))
+    proc.run_until_idle()
+    dt = time.monotonic() - t0
+
+    total_sets = counts["sync"] + counts["change"] + counts["contrib"]
+    print(json.dumps({
+        "metric": "capella_storm",
+        "storm_seconds": round(dt, 3),
+        "sets_per_sec": round(total_sets / dt, 1),
+        "counts": counts,
+        "batches": proc.stats.batches,
+        "batch_sizes": sorted(set(batch_sizes), reverse=True)[:8],
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -131,3 +131,50 @@ def test_persistence_roundtrip(rig):
     fresh = OperationPool(rig.types, rig.spec)
     fresh.restore(chain.store)
     assert fresh.num_attestations() == n_before
+
+
+def test_attester_slashing_freshness_and_prune(rig):
+    """Applied (or otherwise unslashable) slashings must never be re-packed:
+    process_attester_slashing raises 'no validator slashed' on a block that
+    carries one, so a single stale op would brick block production forever
+    (reference: operation_pool's get_slashable_indices freshness filter)."""
+    import copy
+
+    chain = rig.chain
+    t = rig.types
+    pool = OperationPool(t, rig.spec)
+    state = copy.deepcopy(chain.head.state)
+
+    d1 = t.AttestationData(
+        slot=0, index=0,
+        beacon_block_root=b"\x01" * 32,
+        source=t.Checkpoint(epoch=0, root=b"\x02" * 32),
+        target=t.Checkpoint(epoch=0, root=b"\x03" * 32),
+    )
+    d2 = copy.deepcopy(d1)
+    d2.beacon_block_root = b"\x11" * 32  # double vote
+    sig = b"\xc0" + b"\x00" * 95
+    sl = t.AttesterSlashing(
+        attestation_1=t.IndexedAttestation(
+            attesting_indices=[3], data=d1, signature=sig),
+        attestation_2=t.IndexedAttestation(
+            attesting_indices=[3], data=d2, signature=sig),
+    )
+
+    pool.insert_attester_slashing(sl)
+    pool.insert_attester_slashing(sl)  # dedupe by hash_tree_root
+    _, packed, _ = pool.get_slashings_and_exits(state)
+    assert len(packed) == 1
+
+    # applied: covered validator slashed -> never packed again, pruned
+    state.validators[3].slashed = True
+    _, packed, _ = pool.get_slashings_and_exits(state)
+    assert packed == []
+    assert len(pool._attester_slashings) == 0
+
+    # unslashed but past withdrawable_epoch is equally unslashable
+    state.validators[3].slashed = False
+    state.validators[3].withdrawable_epoch = 0
+    pool.insert_attester_slashing(sl)
+    _, packed, _ = pool.get_slashings_and_exits(state)
+    assert packed == []

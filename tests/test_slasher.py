@@ -318,3 +318,91 @@ def test_500k_validators_sparse_instantiation(types):
         current_epoch=cur,
     )
     assert len(found) == 1 and found[0][1].kind == "double_vote"
+
+
+def test_slasher_node_wiring_double_vote_reaches_produced_block():
+    """VERDICT r3 item 6 'Done' criterion: the slasher attached to a live
+    chain (the --slasher / ClientConfig.slasher seam) sees a double vote
+    arrive through REAL attestation verification (gossip unaggregated +
+    aggregate paths), and the found AttesterSlashing flows op pool ->
+    produced block."""
+    from lighthouse_tpu.crypto.bls import api as bls
+    from lighthouse_tpu.op_pool import OperationPool
+    from lighthouse_tpu.state_transition import helpers as h
+    from lighthouse_tpu.state_transition import slot_processing as sp
+    from lighthouse_tpu.types.spec import (
+        DOMAIN_BEACON_ATTESTER,
+        compute_signing_root,
+    )
+    from lighthouse_tpu.testing.harness import BeaconChainHarness
+
+    rig = BeaconChainHarness(n_validators=32)
+    types, spec, chain = rig.types, rig.spec, rig.chain
+    chain.op_pool = OperationPool(types, spec)
+    # The builder seam: ClientConfig(slasher=True) performs exactly this
+    # attach (client/builder.py).
+    chain.slasher_service = SlasherService(Slasher(n_validators=32), types)
+
+    rig.extend_chain(3)
+    slot = rig.current_slot
+    atts = rig.make_attestations(slot)
+    committee = chain.committees_at(slot).committee(slot, 0)
+
+    # Honest vote from committee[0] through the unaggregated gossip path.
+    att1 = rig.single_attestation(atts[0], 0, committee)
+    chain.process_attestation(att1)
+
+    # Conflicting vote: same target epoch, different beacon_block_root
+    # (the parent block — known to fork choice), arriving as an AGGREGATE
+    # (aggregates are not per-attester deduped, the path a real double
+    # vote takes past the observed-attesters cache).
+    head_block = chain.store.get_block(chain.head.block_root)
+    parent_root = bytes(head_block.message.parent_root)
+    data1 = atts[0].data
+    data2 = types.AttestationData(
+        slot=data1.slot, index=data1.index,
+        beacon_block_root=parent_root,
+        source=data1.source, target=data1.target,
+    )
+    state = chain.head_state_for_signatures()
+    domain = rig._domain(state, DOMAIN_BEACON_ATTESTER, data2.target.epoch)
+    root2 = compute_signing_root(data2, types.AttestationData, domain)
+    agg = bls.AggregateSignature.aggregate(
+        [rig.keys[v].sign(root2) for v in committee]
+    )
+    att2 = types.Attestation(
+        aggregation_bits=[True] * len(committee),
+        data=data2,
+        signature=bls.Signature(
+            point=agg.point, subgroup_checked=True
+        ).to_bytes(),
+    )
+    signed_agg = rig.make_aggregate(att2, committee)
+    chain.process_aggregate(signed_agg)
+
+    # Produce the next block: the found slashing must ride it.
+    rig.advance_slot()
+    pslot = rig.current_slot
+    proposer_state = chain.state_for_block_import(chain.head.block_root)
+    proposer_state = sp.process_slots(
+        proposer_state, types, spec, pslot, fork=chain.fork_at(pslot))
+    proposer = h.get_beacon_proposer_index(proposer_state, spec)
+    block, post = chain.produce_block(
+        pslot,
+        randao_reveal=rig.randao_reveal(
+            proposer_state, spec.epoch_at_slot(pslot), proposer
+        ),
+    )
+    slashings = list(block.body.attester_slashings)
+    assert len(slashings) >= 1, "double vote did not reach the block"
+    sl = slashings[0]
+    both = set(sl.attestation_1.attesting_indices) & set(
+        sl.attestation_2.attesting_indices)
+    assert committee[0] in both
+
+    # The produced block is VALID (the slashing passes block processing).
+    signed = rig.sign_block(chain.head_state_for_signatures(), block,
+                            chain.fork_at(pslot))
+    chain.process_block(signed)
+    # And the slashed validator is marked slashed in the post state.
+    assert bool(chain.head.state.validators[committee[0]].slashed)
