@@ -45,6 +45,7 @@ import secrets
 import socket
 import threading
 import time
+from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
 
 from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
@@ -377,10 +378,17 @@ class Discv5Service:
         self.port = self.sock.getsockname()[1]
         self.records: Dict[bytes, Enr] = {}
         self.sessions: Dict[bytes, Session] = {}
-        # nonce -> (dest_node_id, dest_pubkey, addr, pending_message)
-        self._pending_out: Dict[bytes, tuple] = {}
-        # (addr, nonce-of-our-whoareyou) -> challenge-data
-        self._challenges: Dict[bytes, bytes] = {}
+        # nonce -> (deadline, dest_node_id, dest_pubkey, addr, message).
+        # Entries exist only to answer a WHOAREYOU echoing the nonce, so
+        # they expire after the handshake window and the map is size-capped
+        # (a healthy session never triggers the WHOAREYOU, so nothing else
+        # would ever prune them).
+        self._pending_out: "OrderedDict[bytes, tuple]" = OrderedDict()
+        # (src-node-id, src-addr) -> (deadline, challenge-data). Keyed by
+        # addr as well so a forged handshake naming a victim's node id
+        # cannot consume the victim's outstanding challenge (the reference
+        # keys challenges by (node-id, socket-addr)).
+        self._challenges: Dict[tuple, tuple] = {}
         self._responses: Dict[bytes, list] = {}
         self._response_cv = threading.Condition()
         self._running = False
@@ -418,6 +426,30 @@ class Discv5Service:
 
     # ------------------------------------------------------------- sending
 
+    _HANDSHAKE_WINDOW = 10.0     # seconds a nonce stays answerable
+    _PENDING_CAP = 1024          # hard bound on outstanding nonces
+
+    def _remember_nonce(self, nonce: bytes, entry: tuple) -> None:
+        """Track an outgoing nonce for a possible WHOAREYOU echo, expiring
+        stale entries and enforcing the size cap (ADVICE r4: unbounded
+        growth on healthy long-running sessions)."""
+        now = time.monotonic()
+        self._pending_out[nonce] = (now + self._HANDSHAKE_WINDOW,) + entry
+        # Prune defensively: the recv thread pops concurrently (WHOAREYOU
+        # arrivals), so every compound read here tolerates a lost race.
+        while self._pending_out:
+            try:
+                oldest = next(iter(self._pending_out))
+            except StopIteration:       # emptied between check and iter
+                break
+            head = self._pending_out.get(oldest)
+            if head is None:
+                continue                # recv thread consumed it; retry
+            if head[0] < now or len(self._pending_out) > self._PENDING_CAP:
+                self._pending_out.pop(oldest, None)
+            else:
+                break
+
     def _send_message(self, dest: Enr, message: bytes) -> None:
         addr = self._addr_of(dest)
         if addr is None:
@@ -429,15 +461,15 @@ class Discv5Service:
         if sess is None:
             # No session: random-looking filler triggers WHOAREYOU (spec
             # §"Sessions": senders MAY transmit random data).
-            self._pending_out[nonce] = (dest.node_id, dest.pubkey, addr,
-                                        message)
+            self._remember_nonce(nonce, (dest.node_id, dest.pubkey, addr,
+                                         message))
             body = secrets.token_bytes(max(16, len(message)))
             self.sock.sendto(
                 encode_packet(dest.node_id, header, body, iv), addr)
             return
         ad = iv + header.encode()
         body = encrypt_message(sess.send_key, nonce, message, ad)
-        self._pending_out[nonce] = (dest.node_id, dest.pubkey, addr, message)
+        self._remember_nonce(nonce, (dest.node_id, dest.pubkey, addr, message))
         self.sock.sendto(encode_packet(dest.node_id, header, body, iv), addr)
 
     def ping(self, dest: Enr, timeout: float = 2.0) -> bool:
@@ -533,7 +565,9 @@ class Discv5Service:
         pending = self._pending_out.pop(header.nonce, None)
         if pending is None:
             return
-        dest_node_id, dest_pubkey, dest_addr, message = pending
+        deadline, dest_node_id, dest_pubkey, dest_addr, message = pending
+        if deadline < time.monotonic():
+            return                      # stale nonce: window expired
         if len(header.authdata) != 24:
             raise Discv5Error("bad WHOAREYOU authdata")
         enr_seq = int.from_bytes(header.authdata[16:24], "big")
@@ -555,8 +589,8 @@ class Discv5Service:
         body = encrypt_message(ikey, nonce, message, ad)
         # We initiated: we send with initiator-key, read with recipient-key.
         self.sessions[dest_node_id] = Session(send_key=ikey, recv_key=rkey)
-        self._pending_out[nonce] = (dest_node_id, dest_pubkey, dest_addr,
-                                    message)
+        self._remember_nonce(nonce, (dest_node_id, dest_pubkey, dest_addr,
+                                     message))
         self.stats["handshakes"] += 1
         self.sock.sendto(encode_packet(dest_node_id, hs, body, iv),
                          dest_addr)
@@ -583,7 +617,21 @@ class Discv5Service:
         way = Header(FLAG_WHOAREYOU, header.nonce,
                      id_nonce + seq.to_bytes(8, "big"))
         iv_out = secrets.token_bytes(16)
-        self._challenges[src_id] = challenge_data_of(iv_out, way)
+        self._challenges[(src_id, addr)] = (
+            time.monotonic() + self._HANDSHAKE_WINDOW,
+            challenge_data_of(iv_out, way),
+        )
+        if len(self._challenges) > self._PENDING_CAP:
+            now = time.monotonic()
+            fresh = {
+                k: v for k, v in self._challenges.items() if v[0] >= now
+            }
+            if len(fresh) > self._PENDING_CAP:
+                # All-fresh flood (spoofed src ids): hard-evict the oldest
+                # deadlines so the cap actually binds.
+                keep = sorted(fresh.items(), key=lambda kv: kv[1][0])
+                fresh = dict(keep[-self._PENDING_CAP:])
+            self._challenges = fresh
         self.stats["whoareyou_sent"] += 1
         self.sock.sendto(encode_packet(src_id, way, b"", iv_out), addr)
 
@@ -600,9 +648,16 @@ class Discv5Service:
         sig = ad_auth[34:34 + sig_size]
         eph_pub = ad_auth[34 + sig_size:34 + sig_size + eph_size]
         record_raw = ad_auth[34 + sig_size + eph_size:]
-        challenge_data = self._challenges.pop(src_id, None)
-        if challenge_data is None:
+        # Looked up (not popped) until the id-signature verifies: a forged
+        # handshake naming this node id must not consume the genuine
+        # peer's outstanding challenge (ADVICE r4 off-path handshake DoS).
+        entry = self._challenges.get((src_id, addr))
+        if entry is None:
             raise Discv5Error("handshake without challenge")
+        if entry[0] < time.monotonic():
+            self._challenges.pop((src_id, addr), None)
+            raise Discv5Error("challenge expired")
+        challenge_data = entry[1]
         enr = None
         if record_raw:
             enr = Enr.from_rlp(rlp_encode(rlp_decode(record_raw)))
@@ -613,6 +668,7 @@ class Discv5Service:
         if not id_verify(enr.pubkey, sig, challenge_data, eph_pub,
                          self.node_id):
             raise Discv5Error("bad id signature")
+        self._challenges.pop((src_id, addr), None)   # consumed only now
         secret = ecdh(self.key, eph_pub)
         ikey, rkey = derive_session_keys(
             secret, src_id, self.node_id, challenge_data)

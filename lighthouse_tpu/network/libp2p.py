@@ -649,6 +649,14 @@ class YamuxSession:
         with self._lock:
             stream = self._streams.get(sid)
             if stream is None and flags & _F_SYN:
+                # Yamux spec: dialer opens odd ids, listener even. An
+                # inbound SYN on an id of OUR parity would later collide
+                # with open_stream allocating the same id and cross-wire
+                # two logical streams (ADVICE r4) — reject it.
+                local_parity = 1 if self.client else 0
+                if sid % 2 == local_parity:
+                    self._send_frame(_y_header(_Y_DATA, _F_RST, sid, 0))
+                    return None
                 stream = YamuxStream(self, sid)
                 self._streams[sid] = stream
                 if self.on_stream is not None:

@@ -188,7 +188,7 @@ class OperationPool:
             proposer = [
                 s for idx, s in self._proposer_slashings.items()
                 if idx < len(state.validators)
-                and not state.validators[idx].slashed
+                and h.is_slashable_validator(state.validators[idx], epoch)
             ][: P.MAX_PROPOSER_SLASHINGS]
             # Drop slashings with no slashable covered validator left
             # (slashed / past withdrawable_epoch are both monotone), and
@@ -198,7 +198,16 @@ class OperationPool:
             # fresh targets are a subset of A's (e.g. the same pair with
             # attestation_1/2 swapped — different root, same coverage)
             # would slash no one and invalidate our own block.
-            stale, attester, packed_cover = [], [], set()
+            # Cross-op interaction (operation_pool/src/lib.rs:390-399 seeds
+            # to_be_slashed with the proposer-slashing indices): a packed
+            # proposer slashing slashes its validator, so an attester
+            # slashing whose fresh targets it already covers would slash
+            # no one — seed packed_cover with the proposer indices.
+            stale, attester = [], []
+            packed_cover = {
+                int(s.signed_header_1.message.proposer_index)
+                for s in proposer
+            }
             for root, s in self._attester_slashings.items():
                 targets = self.slashing_fresh_targets(s, state, epoch)
                 if not targets:
@@ -210,9 +219,13 @@ class OperationPool:
                     packed_cover |= targets
             for root in stale:
                 self._attester_slashings.pop(root, None)
+            # An exit for a validator slashed earlier in this block fails
+            # the exit_epoch == FAR_FUTURE check (slashing initiates the
+            # exit), so exclude everything in packed_cover.
             exits = [
                 e for idx, e in self._exits.items()
                 if idx < len(state.validators)
+                and idx not in packed_cover
                 and state.validators[idx].exit_epoch == 2**64 - 1
             ][: P.MAX_VOLUNTARY_EXITS]
         return proposer, attester, exits

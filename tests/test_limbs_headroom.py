@@ -1,0 +1,118 @@
+"""Headroom property tests for the lazy limb contract (ADVICE r4 #5).
+
+The module contract of ops/limbs.py is informal: inputs to a multiply
+must satisfy |digit| <= 2^20 and |value| < 2^392, and `_reduce_light`
+claims outputs with digits < 2^17.6 and value < 2^388.4 ("three lazy
+add/sub levels of headroom"). Nothing used to pin those bounds; a tower
+change that chained one extra lazy op before a squeeze would silently
+overflow and corrupt pairings. These tests drive WORST-CASE digit
+magnitudes through each documented consumer chain and check both the
+numeric bounds and exact values against Python-int ground truth.
+"""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto.bls import fields as of
+from lighthouse_tpu.crypto.bls.constants import P
+from lighthouse_tpu.ops import limbs as lb
+from lighthouse_tpu.ops import tower as tw
+
+
+def _wc_lazy(rng, n):
+    """(n, L) lazy vectors at the contract's edge: |digit| = 2^20 on limbs
+    0..46 (random signs), top limb bounded so |value| < 2^392."""
+    d = (rng.integers(0, 2, size=(n, lb.L)) * 2 - 1).astype(np.float64)
+    d *= 2.0 ** 20
+    d[:, 47] = rng.integers(-(2 ** 15), 2 ** 15 + 1, size=(n,))
+    for row in d:
+        assert abs(lb.limbs_to_int(row)) < 2 ** 392
+    return d.astype(np.float32)
+
+
+def test_mul_accepts_contract_edge_inputs():
+    rng = np.random.default_rng(1)
+    a = _wc_lazy(rng, 8)
+    b = _wc_lazy(rng, 8)
+    out = np.asarray(lb.mul(a, b))
+    for i in range(8):
+        va = lb.limbs_to_int(a[i])
+        vb = lb.limbs_to_int(b[i])
+        assert lb.limbs_to_int(out[i]) % P == (va * vb) % P
+        # loose-canonical output claim: digits in [0, 259), value < 2^384
+        assert out[i].min() >= 0 and out[i].max() < 259
+        assert lb.limbs_to_int(out[i]) < 2 ** 384
+
+
+def test_squeeze_digits_provably_in_range():
+    rng = np.random.default_rng(2)
+    x = _wc_lazy(rng, 16)
+    sq = np.asarray(lb._squeeze(x))
+    assert sq.min() >= 0 and sq.max() <= 256
+    for i in range(16):
+        # value preserved mod p
+        assert lb.limbs_to_int(sq[i]) % P == lb.limbs_to_int(x[i]) % P
+
+
+def test_reduce_light_documented_bounds_and_consumers():
+    """mul -> light -> (3 lazy add levels) -> mul, the deepest documented
+    chain: light outputs must stay within their stated bounds and the
+    final multiply must stay exact."""
+    rng = np.random.default_rng(3)
+    n = 6
+    ints = [int.from_bytes(rng.bytes(48), "little") % P for _ in range(2 * n)]
+    a = lb.ints_to_mont(ints[:n])
+    b = lb.ints_to_mont(ints[n:])
+    # Direct light-reduction exercise: columns of a genuine product.
+    na = lb._squeeze(a)
+    nb = lb._squeeze(b)
+    cols = lb.ntt_inv_cols(lb.ntt_center(lb.ntt_fwd(na) * lb.ntt_fwd(nb)))
+    light = np.asarray(lb._reduce_light(cols))
+    for i in range(n):
+        v = lb.limbs_to_int(light[i])
+        assert v % P == (ints[i] * ints[n + i]) % P
+        assert abs(light[i]).max() < 2 ** 17.6, "digit bound regressed"
+        assert abs(v) < 2 ** 388.4, "value bound regressed"
+    # Three lazy add levels on light outputs must stay inside the squeeze
+    # contract (the docstring's claimed headroom), then multiply exactly.
+    s = (light + light) + ((light + light) + (light + light))  # 6x, 3 levels
+    for i in range(n):
+        assert abs(s[i]).max() <= 2 ** 20
+        assert abs(lb.limbs_to_int(s[i])) < 2 ** 392
+    out = np.asarray(lb.mul(s, b))
+    for i in range(n):
+        want = (6 * ints[i] * ints[n + i] % P) * ints[n + i] % P
+        assert lb.limbs_to_int(out[i]) % P == want
+
+
+def test_fp12_light_conj_sub_eq_chain():
+    """light -> conj -> sub -> is_one: the comparison-path consumer of
+    _out4_light outputs (fp12_eq canonicalizes a lazy difference)."""
+    rng = np.random.default_rng(4)
+
+    def rand_fp12():
+        return tuple(
+            tuple(
+                (int.from_bytes(rng.bytes(48), "little") % P,
+                 int.from_bytes(rng.bytes(48), "little") % P)
+                for _ in range(3)
+            )
+            for _ in range(2)
+        )
+
+    x, y = rand_fp12(), rand_fp12()
+    dx = tw.fp12_from_oracle(x)[None]
+    dy = tw.fp12_from_oracle(y)[None]
+    prod = tw.fp12_mul(dx, dy)            # goes through _out4_light
+    want = of.fp12_mul(x, y)
+    assert tw.fp12_to_oracle(prod[0]) == want
+    conj = tw.fp12_conj(prod)
+    want_conj = (want[0], tuple(of.fp2_neg(c) for c in want[1]))
+    assert bool(tw.fp12_eq(conj, tw.fp12_from_oracle(want_conj)[None])[0])
+    # sub of two equal-value lazy forms is value-zero
+    assert bool(tw.fp12_eq(prod, tw.fp12_from_oracle(want)[None])[0])
+    assert not bool(tw.fp12_eq(prod, conj)[0])
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])
