@@ -98,6 +98,9 @@ class BeaconChain:
         # broadcast callback (NetworkService sets it to gossip-publish).
         self.slasher_service = None
         self.on_attester_slashing_found = None
+        # Head-change hook (events.rs SSE head stream analog on the network
+        # side): NetworkService sets it to publish light-client updates.
+        self.on_head_change = None
         self._lock = threading.RLock()      # import lock (module docstring)
         self._fc_lock = threading.RLock()   # fork-choice lock
 
@@ -939,4 +942,10 @@ class BeaconChain:
                     f"beacon_block_{phase}_delay_seconds",
                     "block pipeline delay relative to the slot start",
                 ).observe(value)
-            return head_root
+            cb = self.on_head_change
+        if cb is not None:
+            try:
+                cb(head_root)
+            except Exception:
+                pass  # network publication must never fail an import
+        return head_root

@@ -80,6 +80,7 @@ class ShapeWarmer:
         from lighthouse_tpu.ops import limbs as lb
 
         u = jnp.zeros((n_bucket, 2, 2, lb.L), dtype=lb.DTYPE)
+        inv_idx = jnp.arange(n_bucket, dtype=jnp.int32)  # all-distinct shape
         pk_proj = jnp.broadcast_to(
             cv.G1.infinity, (n_bucket, k_bucket, 3, lb.L)
         )
@@ -88,7 +89,18 @@ class ShapeWarmer:
         set_mask = jnp.zeros((n_bucket,), dtype=bool)   # all padding
         scalars = jnp.asarray(np.ones((n_bucket,), dtype=np.uint64))
         core = be._jitted_core(n_bucket, k_bucket, self.sharded)
-        core(u, pk_proj, sig_proj, sig_checked, set_mask, scalars)
+        core(u, inv_idx, pk_proj, sig_proj, sig_checked, set_mask, scalars)
+        # Also warm a hash-consed h2c shape (committee-repeated messages
+        # collapse u to ~n/256 distinct rows in the gossip firehose). The
+        # fresh jit here still populates the shared persistent cache.
+        m_small = max(1, n_bucket // 256)
+        if m_small < n_bucket:
+            import jax
+
+            u_s = jnp.zeros((m_small, 2, 2, lb.L), dtype=lb.DTYPE)
+            jax.jit(be._h2g2_gather)(
+                u_s, jnp.zeros((n_bucket,), dtype=jnp.int32)
+            )
 
     def _run(self) -> None:
         for n_bucket, k_bucket in self.shapes:

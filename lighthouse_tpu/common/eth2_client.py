@@ -84,6 +84,47 @@ class BeaconNodeHttpClient:
             f"/eth/v1/beacon/states/{state_id}/validators/{index}"
         )["data"]
 
+    def get_validators(self, state_id: str = "head",
+                       ids: Optional[List[str]] = None,
+                       statuses: Optional[List[str]] = None,
+                       offset: int = 0,
+                       limit: int = 0) -> List[Dict[str, Any]]:
+        """Paginated validators listing (get_beacon_state_validators)."""
+        params: Dict[str, str] = {}
+        if ids:
+            params["id"] = ",".join(str(i) for i in ids)
+        if statuses:
+            params["status"] = ",".join(statuses)
+        if offset:
+            params["offset"] = str(offset)
+        if limit:
+            params["limit"] = str(limit)
+        return self._get(
+            f"/eth/v1/beacon/states/{state_id}/validators", params or None
+        )["data"]
+
+    def get_validator_balances(self, state_id: str = "head",
+                               ids: Optional[List[str]] = None
+                               ) -> List[Dict[str, Any]]:
+        params = {"id": ",".join(str(i) for i in ids)} if ids else None
+        return self._get(
+            f"/eth/v1/beacon/states/{state_id}/validator_balances", params
+        )["data"]
+
+    def get_block_rewards(self, block_id: str = "head") -> Dict[str, Any]:
+        return self._get(f"/eth/v1/beacon/rewards/blocks/{block_id}")["data"]
+
+    def get_light_client_bootstrap(self, block_root: bytes) -> Dict[str, Any]:
+        return self._get(
+            "/eth/v1/beacon/light_client/bootstrap/0x" + block_root.hex()
+        )
+
+    def get_light_client_optimistic_update(self) -> Dict[str, Any]:
+        return self._get("/eth/v1/beacon/light_client/optimistic_update")
+
+    def get_light_client_finality_update(self) -> Dict[str, Any]:
+        return self._get("/eth/v1/beacon/light_client/finality_update")
+
     def get_block(self, block_id: str = "head") -> Dict[str, Any]:
         return self._get(f"/eth/v2/beacon/blocks/{block_id}")
 

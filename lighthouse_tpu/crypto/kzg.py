@@ -315,16 +315,28 @@ class Kzg:
     # ------------------------------------------------------------ challenges
 
     def _challenge(self, blob: bytes, commitment: tuple) -> int:
+        """compute_challenge per the deneb KZG spec (c-kzg-4844): domain ||
+        degree as a 16-byte big-endian int || blob || commitment, hashed to
+        a field element. Round 5: the degree framing was previously
+        len(blob) in 8 bytes — self-consistent, but the reference-tree
+        blobs-bundle fixture (proofs produced by c-kzg) exposed the
+        deviation (tests/test_known_answers.py)."""
         h = hashlib.sha256()
         h.update(b"FSBLOBVERIFY_V1_")
-        h.update(len(blob).to_bytes(8, "big"))
+        h.update((len(blob) // BYTES_PER_FIELD_ELEMENT).to_bytes(16, "big"))
         h.update(blob)
         h.update(cv.g1_to_compressed(commitment))
         return int.from_bytes(h.digest(), "big") % R
 
     def _batch_challenge(self, commitments, zs, ys, proofs) -> int:
+        """compute_r_powers framing per the spec: domain ||
+        FIELD_ELEMENTS_PER_BLOB (8 bytes) || n (8 bytes) || per-proof
+        fields. (The weighting only needs to be unpredictable, but the
+        framing follows c-kzg for parity.)"""
         h = hashlib.sha256()
         h.update(b"RCKZGBATCH___V1_")
+        h.update(len(self.domain).to_bytes(8, "big"))
+        h.update(len(commitments).to_bytes(8, "big"))
         for c, z, y, w in zip(commitments, zs, ys, proofs):
             h.update(cv.g1_to_compressed(c))
             h.update(z.to_bytes(32, "big"))

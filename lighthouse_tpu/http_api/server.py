@@ -247,6 +247,153 @@ class BeaconApiServer:
                 "validator": to_json(chain.types.Validator, v),
             }}
 
+        m = re.fullmatch(r"/eth/v1/beacon/states/([^/]+)/validators", path)
+        if m:
+            # Full listing with id/status filters (http_api/src/lib.rs
+            # get_beacon_state_validators) plus offset/limit pagination for
+            # 1M-validator states (the tooling surface the watch daemon and
+            # validator managers scrape).
+            state = self._state_by_id(m.group(1))
+            epoch = h.get_current_epoch(state, spec)
+            ids = None
+            if "id" in query:
+                ids = []
+                for blob in query["id"]:
+                    for one in blob.split(","):
+                        ids.append(self._validator_index(state, one.strip()))
+            statuses = None
+            if "status" in query:
+                statuses = {
+                    s.strip()
+                    for blob in query["status"] for s in blob.split(",")
+                }
+            offset = int(query.get("offset", ["0"])[0])
+            limit = int(query.get("limit", ["0"])[0])  # 0 = unbounded
+            indices = ids if ids is not None else range(len(state.validators))
+            rows = []
+            skipped = 0
+            for idx in indices:
+                v = state.validators[idx]
+                status = self._validator_status(v, epoch)
+                if statuses and status not in statuses and \
+                        status.split("_")[0] not in statuses:
+                    continue
+                if skipped < offset:
+                    skipped += 1
+                    continue
+                rows.append({
+                    "index": str(idx),
+                    "balance": str(state.balances[idx]),
+                    "status": status,
+                    "validator": to_json(chain.types.Validator, v),
+                })
+                if limit and len(rows) >= limit:
+                    break
+            return {"execution_optimistic": False, "finalized": False,
+                    "data": rows}
+
+        m = re.fullmatch(r"/eth/v1/beacon/states/([^/]+)/validator_balances",
+                         path)
+        if m:
+            state = self._state_by_id(m.group(1))
+            ids = None
+            if "id" in query:
+                ids = []
+                for blob in query["id"]:
+                    for one in blob.split(","):
+                        ids.append(self._validator_index(state, one.strip()))
+            indices = ids if ids is not None else range(len(state.validators))
+            return {"data": [
+                {"index": str(i), "balance": str(state.balances[i])}
+                for i in indices
+            ]}
+
+        m = re.fullmatch(r"/eth/v1/beacon/rewards/blocks/([^/]+)", path)
+        if m:
+            # Standard Beacon API block-rewards route, backed by the same
+            # engine as /lighthouse/analysis/block_rewards
+            # (http_api/src/block_rewards.rs).
+            from lighthouse_tpu.beacon_chain import analysis
+
+            signed = self._block_by_id(m.group(1))
+            slot = int(signed.message.slot)
+            rows = analysis.compute_block_rewards(chain, slot, slot)
+            if not rows:
+                raise ApiError(404, "no reward data for block")
+            r = rows[0]
+            return {"execution_optimistic": False, "finalized": False,
+                    "data": {
+                        "proposer_index": str(r["meta"]["proposer_index"]),
+                        "total": str(r["total"]),
+                        "attestations": str(r["attestation_rewards"]["total"]),
+                        "sync_aggregate": str(r["sync_committee_rewards"]),
+                        "proposer_slashings": str(
+                            r["proposer_slashing_inclusion"]),
+                        "attester_slashings": str(
+                            r["attester_slashing_inclusion"]),
+                    }}
+
+        m = re.fullmatch(r"/eth/v1/beacon/light_client/bootstrap/0x([0-9a-fA-F]{64})",
+                         path)
+        if m:
+            # Light-client API (the reference's light_client server routes;
+            # payload mirrors the LightClientBootstrap Req/Resp protocol,
+            # rpc/protocol.rs:174-176).
+            from lighthouse_tpu import light_client as lc
+
+            try:
+                b = lc.create_bootstrap(chain, bytes.fromhex(m.group(1)))
+            except lc.LightClientError as e:
+                raise ApiError(404, str(e))
+            fork = chain.fork_at(int(b.header.slot))
+            return {"version": fork, "data": {
+                "header": {"beacon": to_json(t.BeaconBlockHeader, b.header)},
+                "current_sync_committee": to_json(
+                    t.SyncCommittee, b.current_sync_committee
+                ),
+                "current_sync_committee_branch": [
+                    "0x" + s.hex() for s in b.proof_branch
+                ],
+            }}
+
+        if path == "/eth/v1/beacon/light_client/optimistic_update":
+            from lighthouse_tpu import light_client as lc
+
+            try:
+                u = lc.create_optimistic_update(chain, chain.head.block_root)
+            except lc.LightClientError as e:
+                raise ApiError(404, str(e))
+            fork = chain.fork_at(int(u.attested_header.slot))
+            return {"version": fork, "data": {
+                "attested_header": {
+                    "beacon": to_json(t.BeaconBlockHeader, u.attested_header)
+                },
+                "sync_aggregate": to_json(t.SyncAggregate, u.sync_aggregate),
+                "signature_slot": str(u.signature_slot),
+            }}
+
+        if path == "/eth/v1/beacon/light_client/finality_update":
+            from lighthouse_tpu import light_client as lc
+
+            try:
+                u = lc.create_finality_update(chain, chain.head.block_root)
+            except lc.LightClientError as e:
+                raise ApiError(404, str(e))
+            fork = chain.fork_at(int(u.attested_header.slot))
+            return {"version": fork, "data": {
+                "attested_header": {
+                    "beacon": to_json(t.BeaconBlockHeader, u.attested_header)
+                },
+                "finalized_header": {
+                    "beacon": to_json(t.BeaconBlockHeader, u.finalized_header)
+                },
+                "finality_branch": [
+                    "0x" + s.hex() for s in u.finality_branch
+                ],
+                "sync_aggregate": to_json(t.SyncAggregate, u.sync_aggregate),
+                "signature_slot": str(u.signature_slot),
+            }}
+
         m = re.fullmatch(r"/eth/v1/beacon/headers/([^/]+)", path)
         if m:
             if m.group(1) == "head":

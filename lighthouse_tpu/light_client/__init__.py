@@ -10,6 +10,12 @@ from .light_client import (
     create_bootstrap,
     create_finality_update,
     create_optimistic_update,
+    deserialize_bootstrap,
+    deserialize_finality_update,
+    deserialize_optimistic_update,
+    serialize_bootstrap,
+    serialize_finality_update,
+    serialize_optimistic_update,
 )
 
 __all__ = [
@@ -21,4 +27,10 @@ __all__ = [
     "create_bootstrap",
     "create_finality_update",
     "create_optimistic_update",
+    "deserialize_bootstrap",
+    "deserialize_finality_update",
+    "deserialize_optimistic_update",
+    "serialize_bootstrap",
+    "serialize_finality_update",
+    "serialize_optimistic_update",
 ]

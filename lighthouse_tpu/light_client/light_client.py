@@ -159,6 +159,105 @@ def _expected_field_index(state_cls, field: str) -> int:
     return [f for f, _ in state_cls._ssz_fields].index(field)
 
 
+# ------------------------------------------------------------- wire codecs
+#
+# Req/Resp + gossip payloads (the reference serves SSZ containers over
+# ssz_snappy — rpc/protocol.rs:174-176, types/topics.rs:23-41). Each typed
+# component rides its own SSZ encoding inside a u32-length frame so the
+# payload survives preset changes without a size table.
+
+import struct as _struct
+
+
+def _w(chunks: List[bytes]) -> bytes:
+    return b"".join(_struct.pack("<I", len(c)) + c for c in chunks)
+
+
+def _r(data: bytes) -> List[bytes]:
+    out, off = [], 0
+    while off < len(data):
+        if off + 4 > len(data):
+            raise LightClientError("truncated light-client payload")
+        (n,) = _struct.unpack_from("<I", data, off)
+        off += 4
+        if off + n > len(data):
+            raise LightClientError("truncated light-client payload")
+        out.append(data[off:off + n])
+        off += n
+    return out
+
+
+def _branch_bytes(branch: List[bytes]) -> bytes:
+    return b"".join(branch)
+
+
+def _branch_list(data: bytes) -> List[bytes]:
+    if len(data) % 32:
+        raise LightClientError("bad proof branch length")
+    return [data[i:i + 32] for i in range(0, len(data), 32)]
+
+
+def serialize_bootstrap(types, b: LightClientBootstrap) -> bytes:
+    return _w([
+        types.BeaconBlockHeader.serialize(b.header),
+        types.SyncCommittee.serialize(b.current_sync_committee),
+        _struct.pack("<Q", b.proof_index),
+        _branch_bytes(b.proof_branch),
+    ])
+
+
+def deserialize_bootstrap(types, data: bytes) -> LightClientBootstrap:
+    h, sc, idx, branch = _r(data)
+    return LightClientBootstrap(
+        header=types.BeaconBlockHeader.deserialize(h),
+        current_sync_committee=types.SyncCommittee.deserialize(sc),
+        proof_index=_struct.unpack("<Q", idx)[0],
+        proof_branch=_branch_list(branch),
+    )
+
+
+def serialize_optimistic_update(types, u: LightClientUpdate) -> bytes:
+    return _w([
+        types.BeaconBlockHeader.serialize(u.attested_header),
+        types.SyncAggregate.serialize(u.sync_aggregate),
+        _struct.pack("<Q", u.signature_slot),
+    ])
+
+
+def deserialize_optimistic_update(types, data: bytes) -> LightClientUpdate:
+    h, agg, slot = _r(data)
+    return LightClientUpdate(
+        attested_header=types.BeaconBlockHeader.deserialize(h),
+        sync_aggregate=types.SyncAggregate.deserialize(agg),
+        signature_slot=_struct.unpack("<Q", slot)[0],
+    )
+
+
+def serialize_finality_update(types, u: LightClientFinalityUpdate) -> bytes:
+    return _w([
+        types.BeaconBlockHeader.serialize(u.attested_header),
+        types.BeaconBlockHeader.serialize(u.finalized_header),
+        _struct.pack("<QQ", u.finalized_epoch, u.finality_proof_index),
+        _branch_bytes(u.finality_branch),
+        types.SyncAggregate.serialize(u.sync_aggregate),
+        _struct.pack("<Q", u.signature_slot),
+    ])
+
+
+def deserialize_finality_update(types, data: bytes) -> LightClientFinalityUpdate:
+    ah, fh, nums, branch, agg, slot = _r(data)
+    epoch, idx = _struct.unpack("<QQ", nums)
+    return LightClientFinalityUpdate(
+        attested_header=types.BeaconBlockHeader.deserialize(ah),
+        finalized_header=types.BeaconBlockHeader.deserialize(fh),
+        finalized_epoch=epoch,
+        finality_proof_index=idx,
+        finality_branch=_branch_list(branch),
+        sync_aggregate=types.SyncAggregate.deserialize(agg),
+        signature_slot=_struct.unpack("<Q", slot)[0],
+    )
+
+
 # ---------------------------------------------------------------- client
 
 

@@ -32,6 +32,8 @@ from lighthouse_tpu.network.types import (
     beacon_aggregate_and_proof_topic,
     beacon_block_topic,
     compute_subnet_for_attestation,
+    light_client_finality_update_topic,
+    light_client_optimistic_update_topic,
 )
 from lighthouse_tpu.types.spec import compute_fork_digest
 
@@ -67,6 +69,9 @@ class NetworkService:
             chain.spec.fork_version_for_name(chain.fork_at(chain.current_slot())),
             bytes(chain.head.state.genesis_validators_root),
         )
+        self.light_client_store = None
+        self._lc_seen_optimistic = 0
+        self._lc_seen_finality = 0
         self._lock = threading.RLock()
         if hasattr(transport, "register"):
             transport.register(self)
@@ -157,6 +162,30 @@ class NetworkService:
         self.rpc.register(Protocol.BLOCKS_BY_RANGE, self._serve_blocks_by_range)
         self.rpc.register(Protocol.BLOCKS_BY_ROOT, self._serve_blocks_by_root)
         self.rpc.register(Protocol.METADATA, lambda src, req: [b"\x00" * 24])
+        self.rpc.register(
+            Protocol.LIGHT_CLIENT_BOOTSTRAP, self._serve_light_client_bootstrap
+        )
+
+    def _serve_light_client_bootstrap(self, src: str, req: bytes) -> List[bytes]:
+        """LightClientBootstrap by block root (rpc/protocol.rs:174-176):
+        request = 32-byte root, one response chunk with the bootstrap."""
+        from lighthouse_tpu import light_client as lc
+
+        if len(req) != 32:
+            raise ValueError("bootstrap request must be a 32-byte root")
+        bootstrap = lc.create_bootstrap(self.chain, req)
+        return [lc.serialize_bootstrap(self.chain.types, bootstrap)]
+
+    def request_light_client_bootstrap(self, peer_id: str, block_root: bytes):
+        """Client side: fetch + decode a bootstrap from `peer_id`."""
+        from lighthouse_tpu import light_client as lc
+
+        chunks = self.rpc.request(
+            peer_id, Protocol.LIGHT_CLIENT_BOOTSTRAP, block_root
+        )
+        if not chunks:
+            raise RpcError(3, "no bootstrap")
+        return lc.deserialize_bootstrap(self.chain.types, chunks[0])
 
     def _serve_status(self, src: str, req: bytes) -> List[bytes]:
         self.on_peer_status(src, Status.from_bytes(req))
@@ -210,9 +239,20 @@ class NetworkService:
             attester_slashing_topic(fd),
             validator=self._validate_attester_slashing,
         )
+        self.gossip.subscribe(
+            light_client_finality_update_topic(fd),
+            validator=self._validate_lc_finality_update,
+        )
+        self.gossip.subscribe(
+            light_client_optimistic_update_topic(fd),
+            validator=self._validate_lc_optimistic_update,
+        )
         # Slasher broadcast hook (slasher/service): locally-found
         # slashings gossip out and enter peers' op pools.
         self.chain.on_attester_slashing_found = self.publish_attester_slashing
+        # Light-client server: publish finality/optimistic updates when the
+        # head moves (types/topics.rs:23-41 LC topics).
+        self.chain.on_head_change = self.publish_light_client_updates
 
     def publish_block(self, signed_block) -> int:
         return self.gossip.publish(
@@ -242,6 +282,38 @@ class NetworkService:
         return self.gossip.publish(
             attester_slashing_topic(self.fork_digest), data
         )
+
+    def publish_light_client_updates(self, head_root: bytes) -> None:
+        """Serve the light client over gossip: on head change, publish an
+        optimistic update for the new head and — when its sync aggregate
+        also finalizes something — a finality update. Best-effort: a head
+        whose parent/state is unavailable publishes nothing."""
+        from lighthouse_tpu import light_client as lc
+
+        t = self.chain.types
+        # Only recent heads are useful to light clients; range-sync imports
+        # call recompute_head per block and must not pay update assembly +
+        # publish for every historical head (review r5 finding).
+        if int(self.chain.head.state.slot) + 2 < self.chain.current_slot():
+            return
+        try:
+            upd = lc.create_optimistic_update(self.chain, head_root)
+            if any(upd.sync_aggregate.sync_committee_bits):
+                self.gossip.publish(
+                    light_client_optimistic_update_topic(self.fork_digest),
+                    lc.serialize_optimistic_update(t, upd),
+                )
+        except lc.LightClientError:
+            pass
+        try:
+            fin = lc.create_finality_update(self.chain, head_root)
+            if any(fin.sync_aggregate.sync_committee_bits):
+                self.gossip.publish(
+                    light_client_finality_update_topic(self.fork_digest),
+                    lc.serialize_finality_update(t, fin),
+                )
+        except lc.LightClientError:
+            pass
 
     # ------------------------------------------------------- gossip validate
     #
@@ -387,3 +459,72 @@ class NetworkService:
             self.chain.process_aggregate(agg)
         except AttestationError:
             pass
+
+    # ------------------------------------------------- light-client gossip
+    #
+    # Gossip conditions (the reference's light_client_*_update validation):
+    # decodable, newer than anything already seen on the topic (one winner
+    # per slot), else IGNORE. A node following as a light client attaches a
+    # LightClientStore via `attach_light_client_store`; cryptographic
+    # verification (sync-aggregate signature, finality proof) then runs in
+    # the store and a failure REJECTs the message.
+
+    def attach_light_client_store(self, store) -> None:
+        self.light_client_store = store
+
+    def _lc_update_gate(self, upd, seen_slot: int) -> Optional[str]:
+        """Shared gossip conditions: not a replay, not from the future, and
+        — on a full node with no attached store — the attested header must
+        be a block this chain knows. Unverified messages must NEVER advance
+        the seen-slot watermark (a forged signature_slot of 2^64-1 would
+        otherwise squelch the topic forever)."""
+        if upd.signature_slot <= seen_slot:
+            return IGNORE
+        if upd.signature_slot > self.chain.current_slot() + 1:
+            return IGNORE
+        if getattr(self, "light_client_store", None) is None:
+            t = self.chain.types
+            root = t.BeaconBlockHeader.hash_tree_root(upd.attested_header)
+            if self.chain.store.get_block(bytes(root)) is None:
+                return IGNORE
+        return None
+
+    def _validate_lc_optimistic_update(self, topic: str, data: bytes,
+                                       origin: str) -> str:
+        from lighthouse_tpu import light_client as lc
+
+        try:
+            upd = lc.deserialize_optimistic_update(self.chain.types, data)
+        except Exception:
+            return REJECT
+        verdict = self._lc_update_gate(upd, self._lc_seen_optimistic)
+        if verdict is not None:
+            return verdict
+        store = getattr(self, "light_client_store", None)
+        if store is not None:
+            try:
+                store.process_optimistic_update(upd)
+            except lc.LightClientError:
+                return REJECT
+        self._lc_seen_optimistic = upd.signature_slot
+        return ACCEPT
+
+    def _validate_lc_finality_update(self, topic: str, data: bytes,
+                                     origin: str) -> str:
+        from lighthouse_tpu import light_client as lc
+
+        try:
+            upd = lc.deserialize_finality_update(self.chain.types, data)
+        except Exception:
+            return REJECT
+        verdict = self._lc_update_gate(upd, self._lc_seen_finality)
+        if verdict is not None:
+            return verdict
+        store = getattr(self, "light_client_store", None)
+        if store is not None:
+            try:
+                store.process_finality_update(upd)
+            except lc.LightClientError:
+                return REJECT
+        self._lc_seen_finality = upd.signature_slot
+        return ACCEPT

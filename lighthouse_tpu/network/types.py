@@ -64,6 +64,16 @@ def bls_to_execution_change_topic(fork_digest: bytes) -> str:
     return topic("bls_to_execution_change", fork_digest)
 
 
+def light_client_finality_update_topic(fork_digest: bytes) -> str:
+    """types/topics.rs:23-41 LIGHT_CLIENT_FINALITY_UPDATE."""
+    return topic("light_client_finality_update", fork_digest)
+
+
+def light_client_optimistic_update_topic(fork_digest: bytes) -> str:
+    """types/topics.rs:23-41 LIGHT_CLIENT_OPTIMISTIC_UPDATE."""
+    return topic("light_client_optimistic_update", fork_digest)
+
+
 def compute_subnet_for_attestation(spec, slot: int, committee_index: int,
                                    committees_per_slot: int) -> int:
     """Spec compute_subnet_for_attestation."""

@@ -56,7 +56,7 @@ def _scalars_to_words(xs: Sequence[int]) -> np.ndarray:
 
 
 def _combine(commit_proj, proof_proj, g2_neg_proj, g2_x_minus, g1_gen_proj,
-             y_words, z_words, r_words):
+             y_words, z_words, r_words, nbits: int = 256):
     """Device graph: lhs_i = r^i (C_i - [y_i]G1 + [z_i]W_i); reduce; pair.
 
     The two-pair identity (batch form of verify_kzg_proof):
@@ -73,6 +73,7 @@ def _combine(commit_proj, proof_proj, g2_neg_proj, g2_x_minus, g1_gen_proj,
     a = cv.G1.mul_var_scalar_wide(
         jnp.concatenate([g1b, proof_proj]),
         jnp.concatenate([y_words, z_words]),
+        nbits=nbits,
     )
     y_g1, z_w = a[:n], a[n:]
     term = cv.G1.add(cv.G1.add(commit_proj, cv.G1.neg(y_g1)), z_w)
@@ -80,6 +81,7 @@ def _combine(commit_proj, proof_proj, g2_neg_proj, g2_x_minus, g1_gen_proj,
     b = cv.G1.mul_var_scalar_wide(
         jnp.concatenate([term, proof_proj]),
         jnp.concatenate([r_words, r_words]),
+        nbits=nbits,
     )
     lhs_sum = cv.G1.msm_reduce(b[:n], n)
     w_sum = cv.G1.msm_reduce(b[n:], n)
@@ -91,9 +93,9 @@ def _combine(commit_proj, proof_proj, g2_neg_proj, g2_x_minus, g1_gen_proj,
 
 
 @lru_cache(maxsize=None)
-def _jitted(n_bucket: int):
+def _jitted(n_bucket: int, nbits: int = 256):
     del n_bucket
-    return jax.jit(_combine)
+    return jax.jit(lambda *args: _combine(*args, nbits=nbits))
 
 
 def verify_kzg_batch_device(
@@ -103,6 +105,7 @@ def verify_kzg_batch_device(
     proofs: Sequence[tuple],
     r: int,
     g2_tau_aff,
+    nbits: int = 256,
 ) -> bool:
     """Batched e(C - yG1 + zW, -G2)·e(W, tau G2) check on device. Points are
     oracle affine tuples; scalars Python ints (Fr)."""
@@ -127,7 +130,7 @@ def verify_kzg_batch_device(
     g2_x = pr.to_affine_g2(g2_x_aff[None])[0]
     neg_g2_a = pr.to_affine_g2(neg_g2[None])[0]
 
-    core = _jitted(n_bucket)
+    core = _jitted(n_bucket, nbits)
     out = core(commit_proj, proof_proj, neg_g2_a, g2_x, g1_gen,
                y_words, z_words, r_words)
     return bool(out)

@@ -456,6 +456,73 @@ def _crt_renorm(limbs):
     return out
 
 
+def _inv_gammas(prod, plan):
+    """Per-prime inverse interpolation + centering: centered domain
+    residues (..., n_p, NCOLS) -> list of n_p gamma tensors (..., NCOLS),
+    |gamma_j| <= 0.503 * p_j <= 127 (the CRT weight (M/p_j)^-1 mod p_j is
+    folded into the inverse matrices, so sum_j gamma_j * (M/p_j) is
+    congruent to the true column integer mod M)."""
+    pb = prod.astype(jnp.bfloat16)
+    gs = []
+    for j, p in enumerate(plan.primes):
+        gj = jax.lax.dot_general(
+            pb[..., j, :], plan.w_blocks[j],
+            (((prod.ndim - 2,), (0,)), ((), ())),
+            preferred_element_type=DTYPE,
+        )
+        gs.append(gj - float(p) * jnp.round(gj * float(1.0 / p)))
+    return gs
+
+
+def ntt_inv_cols_fast(prod, plan=_PLAN3):
+    """Round-5 CRT reconstruction WITHOUT the limb-compare correction
+    rounds, for callers honoring the MARGIN CONTRACT below (~60% fewer
+    elementwise ops per column than ntt_inv_cols — the CRT machinery was
+    ~half the VPU time of every tower multiply).
+
+    MARGIN CONTRACT: every true column integer of the represented product
+    polynomial must lie in [2^12, M - 2^12]. The tower's non-negativity
+    offset polynomials already dominate it (plan3 combination margins
+    >= 0.85e6, plan4 >= 2.5e8 — see the budget comments at the offset
+    constructors); the plain mul/sqr path adds a small 2^12 offset
+    polynomial (offset_dom3_mul) for exactly this purpose.
+
+    Why the floor is exact: with the CRT weight folded into gamma,
+    S_k = sum_j gamma_jk * (M/p_j) == col_k (mod M), and the quotient
+    t_k = floor(S_k / M) satisfies S_k/M = sum_j gamma_jk / p_j EXACTLY
+    (since (M/p_j)/M = 1/p_j). The f32 estimate qhat of that 4-term sum
+    (|terms| <= 0.51, |qhat| <= 2.1) carries absolute error
+    <= ~10 * 2^-24 < 1e-6, so floor(qhat) == t_k whenever
+    frac(S_k/M) = col_k / M is farther than 1e-6 from {0, 1} — i.e.
+    col_k in [2^12, M - 2^12] gives a >= 2^9x safety factor even for
+    M4 ~ 2^31.6. Exactness of the limb arithmetic: |S_l| <= 4*127*255
+    < 2^17.3 (f32-exact products <= 127*255), |t| <= 3,
+    |S_l - t*M_l| < 2^17.4, and the renorm carries are < 2^9.5 — every
+    intermediate is an exact-integer f32. The corrected value
+    sum_l (S_l - t M_l) 256^l = S - tM = col_k lies in [0, M) < 256^NL,
+    so after the renorm the spare top limb is provably zero and the
+    [0, 256) digits are the unique base-256 digits of col_k."""
+    gs = _inv_gammas(prod, plan)
+    nl = plan.NL
+    S = [
+        sum(gs[j] * float(plan.m_digits[j, l]) for j in range(plan.n_p))
+        for l in range(nl)
+    ]
+    qhat = sum(gs[j] * float(1.0 / p) for j, p in enumerate(plan.primes))
+    t = jnp.floor(qhat)
+    md = list(plan.M_digits)
+    r = _crt_renorm(
+        [s - t * float(m) for s, m in zip(S, md)] + [jnp.zeros_like(S[0])]
+    )
+    # Assemble columns: limb l of column k lands at column k + l.
+    nd = r[0].ndim
+    parts = []
+    for l, v in enumerate(r):
+        pad = [(0, 0)] * (nd - 1) + [(l, nl - l)]
+        parts.append(jnp.pad(v, pad))
+    return sum(parts)
+
+
 def ntt_inv_cols(prod, plan=_PLAN3):
     """Centered domain residues (..., n_p, NCOLS) of a product polynomial
     -> exact non-negative column digits (..., NCOLS + NL) for _reduce.
@@ -470,16 +537,12 @@ def ntt_inv_cols(prod, plan=_PLAN3):
     S_l = sum_j gamma_j * digit_l(M/p_j) (|S_l| <= n_p*127*255 < 2^17.6).
     The quotient t = floor(S/M) (|t| <= 3) is estimated from a float
     reconstruction of S (error << M) and pinned exactly by one add-M and
-    one subtract-M correction guarded by exact limb comparisons."""
-    pb = prod.astype(jnp.bfloat16)
-    gs = []
-    for j, p in enumerate(plan.primes):
-        gj = jax.lax.dot_general(
-            pb[..., j, :], plan.w_blocks[j],
-            (((prod.ndim - 2,), (0,)), ((), ())),
-            preferred_element_type=DTYPE,
-        )
-        gs.append(gj - float(p) * jnp.round(gj * float(1.0 / p)))
+    one subtract-M correction guarded by exact limb comparisons.
+
+    This is the MARGIN-FREE reconstruction (correct for any true columns
+    in [0, M)); the hot paths use ntt_inv_cols_fast under its margin
+    contract instead."""
+    gs = _inv_gammas(prod, plan)
     nl = plan.NL
     # S limbs: one per M digit plus a signed top.
     S = [
@@ -558,6 +621,7 @@ _OFFSET_DOM3_NP = None
 _OFFSET_DOM4_NP = None
 _OFFSET_DOM3 = None
 _OFFSET_DOM4 = None
+_OFFSET_DOM3_MUL = None
 
 
 def offset_dom3_np() -> np.ndarray:
@@ -588,23 +652,48 @@ def offset_dom4():
     return _OFFSET_DOM4
 
 
+def offset_dom3_mul():
+    """Small (2^12) offset for the PLAIN mul/sqr product: single squeezed
+    products have columns in [0, 51*256^2]; the lower edge (exactly 0 at
+    the outer columns) violates ntt_inv_cols_fast's margin contract, so
+    the plain path shifts every column into [2^12, 3.35e6 + 2^12 + 255]
+    (upper margin vs M3 = 14.46e6 is ~11e6). Value is a multiple of p."""
+    global _OFFSET_DOM3_MUL
+    if _OFFSET_DOM3_MUL is None:
+        _OFFSET_DOM3_MUL = jnp.asarray(
+            _build_offset_dom(_PLAN3, 12), dtype=DTYPE
+        )
+    return _OFFSET_DOM3_MUL
+
+
 def ntt_dom_to_limbs(c, plan, offset_dom, light: bool = False):
     """Signed domain combination -> loose-canonical limbs (..., L): add
     the non-negativity offset, center, interpolate, reduce (Pallas-fused
     on TPU, ops/fused.py). The caller guarantees its combination's true
-    columns + offset lie in [0, M). `light` uses _reduce_light — only
-    for outputs whose consumers tolerate its looser value bound (see its
-    docstring; the Fp12 tower ops)."""
+    columns + offset lie in [0, M) — and in fact comfortably inside
+    [2^12, M - 2^12] (the offset budgets leave >= 0.85e6 of margin), so
+    the fast CRT applies. `light` uses _reduce_light — only for outputs
+    whose consumers tolerate its looser value bound (see its docstring;
+    the Fp12 tower ops)."""
     from . import fused
     if fused.enabled():
         return fused.inv_out(c, plan, with_offset=True)
-    cols = ntt_inv_cols(ntt_center(c + offset_dom, plan), plan)
+    cols = _INV_COLS(ntt_center(c + offset_dom, plan), plan)
     return _reduce_light(cols) if light else _reduce(cols)
 
 
 # --- Core multiply --------------------------------------------------------------
 
 _ENGINE = os.environ.get("LIGHTHOUSE_TPU_MUL_ENGINE", "ntt")
+# CRT reconstruction: "fast" (exact-floor under the margin contract,
+# round 5) or "compare" (limb-compare corrections, rounds 3-4) for A/B.
+_CRT = os.environ.get("LIGHTHOUSE_TPU_CRT", "fast")
+_INV_COLS = ntt_inv_cols_fast if _CRT == "fast" else ntt_inv_cols
+if _CRT == "fast":
+    # Device constants must exist BEFORE any jit trace (a constant created
+    # lazily inside a trace leaks that trace's buffer — the tower module
+    # documents the observed UnexpectedTracerError).
+    offset_dom3_mul()
 
 
 def _col_product(a, b):
@@ -632,6 +721,10 @@ def mul(a, b):
         return _reduce(_col_product(na, nb))
     fa = ntt_fwd(na)
     fb = ntt_fwd(nb)
+    if _CRT == "fast":
+        return _reduce(
+            ntt_inv_cols_fast(ntt_center(fa * fb + offset_dom3_mul()))
+        )
     return _reduce(ntt_inv_cols(ntt_center(fa * fb)))
 
 
@@ -646,6 +739,10 @@ def sqr(a):
     if _ENGINE == "schoolbook":
         return _reduce(_col_product(na, na))
     fa = ntt_fwd(na)
+    if _CRT == "fast":
+        return _reduce(
+            ntt_inv_cols_fast(ntt_center(fa * fa + offset_dom3_mul()))
+        )
     return _reduce(ntt_inv_cols(ntt_center(fa * fa)))
 
 

