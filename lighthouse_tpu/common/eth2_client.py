@@ -114,6 +114,14 @@ class BeaconNodeHttpClient:
     def get_block_rewards(self, block_id: str = "head") -> Dict[str, Any]:
         return self._get(f"/eth/v1/beacon/rewards/blocks/{block_id}")["data"]
 
+    def get_attestation_rewards(self, epoch: int,
+                                ids: Optional[List[str]] = None
+                                ) -> Dict[str, Any]:
+        return self._post(
+            f"/eth/v1/beacon/rewards/attestations/{epoch}",
+            [str(i) for i in ids] if ids else [],
+        )["data"]
+
     def get_light_client_bootstrap(self, block_root: bytes) -> Dict[str, Any]:
         return self._get(
             "/eth/v1/beacon/light_client/bootstrap/0x" + block_root.hex()

@@ -333,6 +333,92 @@ class BeaconApiServer:
                             r["attester_slashing_inclusion"]),
                     }}
 
+        m = re.fullmatch(r"/eth/v1/beacon/rewards/attestations/(\d+)", path)
+        if m and method == "POST":
+            # Standard attestation-rewards route: spec flag deltas over the
+            # requested epoch's participation (epoch e's flags are read
+            # from previous_epoch_participation of a state in epoch e+1 —
+            # the same bits process_epoch rewards from).
+            from lighthouse_tpu.beacon_chain import analysis
+            from lighthouse_tpu.state_transition import epoch_processing as ep
+
+            epoch = int(m.group(1))
+            spe = spec.preset.SLOTS_PER_EPOCH
+            head_slot = int(chain.head.state.slot)
+            # Epoch e's rewards are only final at the END of epoch e+1
+            # (late attestations are includable through all of it, and
+            # process_epoch reads the e+1 end-state's balances): reject
+            # queries before then instead of returning unstable numbers.
+            if (epoch + 2) * spe - 1 > head_slot:
+                raise ApiError(400, "epoch participation not complete yet")
+            try:
+                state = analysis._state_at_slot(chain, (epoch + 2) * spe - 1)
+            except analysis.AnalysisError as e:
+                raise ApiError(404, repr(e))
+            want = None
+            if isinstance(body, list) and body:
+                want = {self._validator_index(state, str(v)) for v in body}
+            src_r, src_p = ep.get_flag_index_deltas(state, spec, 0)
+            tgt_r, tgt_p = ep.get_flag_index_deltas(state, spec, 1)
+            head_r, _ = ep.get_flag_index_deltas(state, spec, 2)
+            fork = chain.fork_at(int(state.slot))
+            inact_p = ep.get_inactivity_penalty_deltas(state, spec, fork)
+            rows = []
+            for i in ep.get_eligible_validator_indices(state, spec):
+                if want is not None and i not in want:
+                    continue
+                rows.append({
+                    "validator_index": str(i),
+                    "head": str(head_r[i]),
+                    "target": str(tgt_r[i] - tgt_p[i]),
+                    "source": str(src_r[i] - src_p[i]),
+                    "inactivity": str(-inact_p[i]),
+                })
+            # ideal_rewards: a perfectly participating validator per
+            # effective-balance tier (the same per-flag formula
+            # get_flag_index_deltas applies, with every flag earned).
+            from lighthouse_tpu.state_transition import (
+                block_processing as bp,
+            )
+            from lighthouse_tpu.state_transition import helpers as sth
+
+            incr = spec.effective_balance_increment
+            active_incr = \
+                sth.get_total_active_balance(state, spec) // incr
+            base_per_incr = bp.get_base_reward_per_increment(state, spec)
+            prev = sth.get_previous_epoch(state, spec)
+            leaking = ep.is_in_inactivity_leak(state, spec)
+            flag_fractions = []
+            for flag, weight in enumerate(ep.PARTICIPATION_FLAG_WEIGHTS):
+                unslashed = ep.get_unslashed_participating_indices(
+                    state, spec, flag, prev
+                )
+                ub_incr = sth.get_total_balance(
+                    state, spec, unslashed) // incr
+                flag_fractions.append((weight, ub_incr))
+            ideal = []
+            for eb in sorted({
+                int(v.effective_balance) for v in state.validators
+            }):
+                base = (eb // incr) * base_per_incr
+                comps = []
+                for weight, ub_incr in flag_fractions:
+                    if leaking:
+                        comps.append(0)
+                    else:
+                        comps.append(
+                            base * weight * ub_incr
+                            // (active_incr * ep.WEIGHT_DENOMINATOR)
+                        )
+                ideal.append({
+                    "effective_balance": str(eb),
+                    "source": str(comps[0]),
+                    "target": str(comps[1]),
+                    "head": str(comps[2]),
+                })
+            return {"execution_optimistic": False, "finalized": False,
+                    "data": {"ideal_rewards": ideal, "total_rewards": rows}}
+
         m = re.fullmatch(r"/eth/v1/beacon/light_client/bootstrap/0x([0-9a-fA-F]{64})",
                          path)
         if m:

@@ -78,21 +78,30 @@ if _TOWER_NTT:
 
 
 def _d2mul(a, b):
-    """Domain Fp2 schoolbook: (..., 2, n_p, N) x (..., 2, n_p, N)."""
-    a0, a1 = a[..., 0, :, :], a[..., 1, :, :]
-    b0, b1 = b[..., 0, :, :], b[..., 1, :, :]
+    """Domain Fp2 schoolbook: (..., 2, n_p, N) x (..., 2, n_p, N).
+
+    Operands may arrive as bf16 (the round-5 storage form of transform
+    outputs — centered residues are integers <= 127, bf16-exact); the
+    arithmetic upcasts so products (<= 127^2) and combination sums stay
+    exact in f32."""
+    a0, a1 = (a[..., 0, :, :].astype(lb.DTYPE),
+              a[..., 1, :, :].astype(lb.DTYPE))
+    b0, b1 = (b[..., 0, :, :].astype(lb.DTYPE),
+              b[..., 1, :, :].astype(lb.DTYPE))
     return jnp.stack([a0 * b0 - a1 * b1, a0 * b1 + a1 * b0], axis=-3)
 
 
 def _d2sqr(a):
-    a0, a1 = a[..., 0, :, :], a[..., 1, :, :]
+    a0, a1 = (a[..., 0, :, :].astype(lb.DTYPE),
+              a[..., 1, :, :].astype(lb.DTYPE))
     p = a0 * a1
     return jnp.stack([a0 * a0 - a1 * a1, p + p], axis=-3)
 
 
 def _dxi(a):
     """Multiply a domain Fp2 by xi = 1 + u."""
-    a0, a1 = a[..., 0, :, :], a[..., 1, :, :]
+    a0, a1 = (a[..., 0, :, :].astype(lb.DTYPE),
+              a[..., 1, :, :].astype(lb.DTYPE))
     return jnp.stack([a0 - a1, a0 + a1], axis=-3)
 
 
@@ -113,12 +122,22 @@ def _d6mul_by_v(A):
     )
 
 
+# Transform outputs are centered residues — exact SMALL integers
+# (|.| <= 127), so they can be STORED in bfloat16: the big domain
+# operand tensors (the ones every _d6mul fusion re-reads from HBM)
+# carry half the bytes, relieving the n=4096 bandwidth cliff
+# (NOTES r4 batch-scaling table). Arithmetic upcasts in _d2mul/_dxi.
+_DOM_BF16 = os.environ.get("LIGHTHOUSE_TPU_DOM_BF16", "1") == "1"
+
+
 def _fwd3(x):
-    return lb.ntt_fwd_lazy(x)
+    r = lb.ntt_fwd_lazy(x)
+    return r.astype(jnp.bfloat16) if _DOM_BF16 else r
 
 
 def _fwd4(x):
-    return lb.ntt_fwd_lazy(x, lb.plan4())
+    r = lb.ntt_fwd_lazy(x, lb.plan4())
+    return r.astype(jnp.bfloat16) if _DOM_BF16 else r
 
 
 def _out3(c):

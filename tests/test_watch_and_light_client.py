@@ -38,7 +38,7 @@ def rig():
     for _ in range(4):
         h.advance_slot()
         vc.run_slot(h.current_slot)
-    yield {"h": h, "client": client}
+    yield {"h": h, "client": client, "vc": vc}
     server.stop()
 
 
@@ -268,12 +268,7 @@ def test_light_client_served_over_network(rig):
 
     # Drive one more sync-aggregated block on the serving node: its head
     # change publishes an optimistic update onto the LC gossip topic.
-    client = rig["client"]
-    vc_store = ValidatorStore(h.types, h.spec)
-    for i, sk in enumerate(h.keys):
-        vc_store.add_validator(sk, index=i)
-    vc = ValidatorClient(
-        vc_store, BeaconNodeFallback([client]), h.types, h.spec)
+    vc = rig["vc"]
     h.advance_slot()
     vc.run_slot(h.current_slot)
 
@@ -319,3 +314,18 @@ def test_light_client_and_validators_api_routes(rig):
     assert "current_sync_committee" in lcb["data"]
     opt = client.get_light_client_optimistic_update()
     assert int(opt["data"]["signature_slot"]) > 0
+
+    # attestation rewards: drive the chain through the end of epoch 1 so
+    # epoch 0's participation is final, then read the decomposition.
+    spe = h.spec.preset.SLOTS_PER_EPOCH
+    vc = rig["vc"]
+    while int(chain.head.state.slot) < 2 * spe - 1:
+        h.advance_slot()
+        vc.run_slot(h.current_slot)
+    rw = client.get_attestation_rewards(0, ids=["0", "1"])
+    rows = rw["total_rewards"]
+    assert [r["validator_index"] for r in rows] == ["0", "1"]
+    assert all(int(r["source"]) != 0 or int(r["target"]) != 0 for r in rows)
+    ideal = rw["ideal_rewards"]
+    assert ideal and all(int(t["target"]) >= int(r["target"]) >= 0
+                         for t in ideal[-1:] for r in rows)
