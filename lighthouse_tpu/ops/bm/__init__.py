@@ -1,0 +1,36 @@
+"""Batch-minor (BM) engine: the round-5 tile-utilization re-layout.
+
+The standard engine (ops/limbs.py and the modules above it) lays a field
+element out as a TRAILING (..., L) limb axis with the batch leading; on
+TPU, XLA tiles the last two dims of every tensor onto (8, 128) f32
+vector registers, so the elementwise tower work — the measured residual
+after rounds 3-5 (NOTES_TPU_PERF.md: VPU-bound at ~30% tile utilization,
+MXU ~2% busy) — runs on (2, 48)-shaped tiles that fill 9.4% of each
+register.
+
+This package re-lays the SAME arithmetic out batch-minor: the batch axis
+is the LAST (lane) axis of every tensor and the limb axis sits at -2
+(sublanes), so a batch of 2048 field elements is a (48, 2048) tensor
+whose tiles are 100% full, and every lazy add/sub/select in the group
+law and tower rides full registers. The NTT/CRT multiply plan, digit
+bounds, non-negativity offsets, and every exactness proof are UNCHANGED
+and are imported from ops/limbs.py — only axis placement differs:
+
+  Fp   : (..., L, n)           limbs at -2, batch minor
+  Fp2  : (..., 2, L, n)
+  Fp6  : (..., 3, 2, L, n)
+  Fp12 : (..., 2, 3, 2, L, n)
+  G1   : (..., 3, L, n)        projective, coords on axis -3
+  G2   : (..., 3, 2, L, n)     projective twist, coords on axis -4
+  domain residues: (..., n_p, NCOLS, n)
+
+Matmuls against the constant evaluation/interpolation/fold matrices
+contract the -2 axis from the LEFT (einsum "kc,...kn->...cn"), which the
+MXU executes as (out x k) @ (k x n) with the batch in the minor
+dimension — no transposes at fusion boundaries (the failure mode of the
+vmap probe, scripts/probe_layout.py).
+
+Selected per-call in ops/backend.py (LIGHTHOUSE_TPU_LAYOUT); chip A/B in
+scripts/probe_bm.py. Differential tests: tests/test_ops_bm.py pins every
+level against the standard engine / the pure-Python oracle.
+"""

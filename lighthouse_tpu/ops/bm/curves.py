@@ -1,0 +1,296 @@
+"""Batch-minor G1/G2 group ops: ops/curves.py re-laid out (batch minor).
+
+Same complete Renes-Costello-Batina formulas, segmented fixed-scalar
+ladders, 2-bit windowed variable-scalar ladders, psi endomorphism and
+Bowe subgroup checks as ops/curves.py — the formula comments there are
+authoritative. Layout:
+
+    G1 point: (..., 3, L, n)      coords on axis -3 (Fp tail = (L, n))
+    G2 point: (..., 3, 2, L, n)   coords on axis -4 (Fp2 tail = (2, L, n))
+
+Masks/scalars are (..., n) and broadcast against the minor batch axis.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.bls import curves as _oc
+from lighthouse_tpu.crypto.bls.constants import BLS_X_ABS, R
+
+from . import limbs as lb
+from . import tower as tw
+
+
+class _FieldAdapter:
+    def __init__(self, tail_ndim, add, sub, neg, mul, is_zero, eq, zero, one):
+        self.tail_ndim = tail_ndim      # dims of one element incl. batch
+        self.add = add
+        self.sub = sub
+        self.neg = neg
+        self.mul = mul
+        self.is_zero = is_zero
+        self.eq = eq
+        self.zero = zero
+        self.one = one
+
+    def mul_many(self, xs, ys):
+        axis = -(self.tail_ndim + 1)
+        prod = self.mul(jnp.stack(xs, axis=axis), jnp.stack(ys, axis=axis))
+        return [jnp.take(prod, i, axis=axis) for i in range(len(xs))]
+
+    def mul_small(self, a, k: int):
+        acc = None
+        dbl = a
+        while k:
+            if k & 1:
+                acc = dbl if acc is None else self.add(acc, dbl)
+            k >>= 1
+            if k:
+                dbl = self.add(dbl, dbl)
+        return acc
+
+
+FP = _FieldAdapter(
+    tail_ndim=2,
+    add=lb.add, sub=lb.sub, neg=lb.neg, mul=lb.mul,
+    is_zero=lb.is_zero, eq=lb.eq, zero=lb.ZERO, one=lb.ONE_MONT,
+)
+
+FP2 = _FieldAdapter(
+    tail_ndim=3,
+    add=lb.add, sub=lb.sub, neg=lb.neg, mul=tw.fp2_mul,
+    is_zero=tw.fp2_is_zero, eq=tw.fp2_eq, zero=tw.FP2_ZERO, one=tw.FP2_ONE,
+)
+
+
+class _Group:
+    """Batch-minor twin of curves._Group (same RCB formulas)."""
+
+    def __init__(self, field: _FieldAdapter, b_mul, b3_mul, name: str):
+        self.f = field
+        self.b_mul = b_mul
+        self.b3_mul = b3_mul
+        self.name = name
+        self.infinity = jnp.stack([field.zero, field.one, field.zero], axis=0)
+
+    def coords(self, p):
+        ax = -(self.f.tail_ndim + 1)
+        return (jnp.take(p, 0, axis=ax), jnp.take(p, 1, axis=ax),
+                jnp.take(p, 2, axis=ax))
+
+    def pack(self, X, Y, Z):
+        return jnp.stack([X, Y, Z], axis=-(self.f.tail_ndim + 1))
+
+    def is_infinity(self, p):
+        _, _, Z = self.coords(p)
+        return self.f.is_zero(Z)
+
+    def on_curve(self, p):
+        f = self.f
+        X, Y, Z = self.coords(p)
+        y2, x2, z2 = f.mul_many([Y, X, Z], [Y, X, Z])
+        y2z, x3, z3 = f.mul_many([y2, x2, z2], [Z, X, Z])
+        return f.is_zero(f.sub(y2z, f.add(x3, self.b_mul(z3))))
+
+    def select(self, mask, a, b):
+        """mask (..., n) bool against points with tail (3, field-tail)."""
+        idx = (Ellipsis,) + (None,) * self.f.tail_ndim + (slice(None),)
+        return jnp.where(mask[idx], a, b)
+
+    def add(self, p, q):
+        f = self.f
+        X1, Y1, Z1 = self.coords(p)
+        X2, Y2, Z2 = self.coords(q)
+        t0, t1, t2, m3, m4, m5 = f.mul_many(
+            [X1, Y1, Z1, f.add(X1, Y1), f.add(Y1, Z1), f.add(X1, Z1)],
+            [X2, Y2, Z2, f.add(X2, Y2), f.add(Y2, Z2), f.add(X2, Z2)],
+        )
+        t3 = f.sub(m3, f.add(t0, t1))
+        t4 = f.sub(m4, f.add(t1, t2))
+        ty = f.sub(m5, f.add(t0, t2))
+        t03 = f.mul_small(t0, 3)
+        t2b = self.b3_mul(t2)
+        z3s = f.add(t1, t2b)
+        t1b = f.sub(t1, t2b)
+        yb = self.b3_mul(ty)
+        p0, p1, p2, p3, p4, p5 = f.mul_many(
+            [t4, t3, yb, t1b, t03, z3s],
+            [yb, t1b, t03, z3s, t3, t4],
+        )
+        return self.pack(f.sub(p1, p0), f.add(p2, p3), f.add(p5, p4))
+
+    def double(self, p):
+        f = self.f
+        X, Y, Z = self.coords(p)
+        t0, t1, t2, txy = f.mul_many([Y, Y, Z, X], [Y, Z, Z, Y])
+        t2b = self.b3_mul(t2)
+        z8 = f.mul_small(t0, 8)
+        y3s = f.add(t0, t2b)
+        t0p = f.sub(t0, f.mul_small(t2b, 3))
+        q0, q1, q2, q3 = f.mul_many([t2b, t1, t0p, t0p], [z8, z8, y3s, txy])
+        return self.pack(f.add(q3, q3), f.add(q0, q2), q1)
+
+    def neg(self, p):
+        X, Y, Z = self.coords(p)
+        return self.pack(X, self.f.neg(Y), Z)
+
+    def eq(self, p, q):
+        f = self.f
+        X1, Y1, Z1 = self.coords(p)
+        X2, Y2, Z2 = self.coords(q)
+        a0, a1, b0, b1 = f.mul_many([X1, Y1, X2, Y2], [Z2, Z2, Z1, Z1])
+        both_inf = jnp.logical_and(f.is_zero(Z1), f.is_zero(Z2))
+        one_inf = jnp.logical_xor(f.is_zero(Z1), f.is_zero(Z2))
+        same = jnp.logical_and(f.eq(a0, b0), f.eq(a1, b1))
+        return jnp.logical_or(both_inf, jnp.logical_and(~one_inf, same))
+
+    def mul_fixed_scalar(self, p, k: int):
+        if k < 0:
+            return self.mul_fixed_scalar(self.neg(p), -k)
+        if k == 0:
+            return jnp.broadcast_to(self.infinity, p.shape)
+        bits = bin(k)[2:]
+
+        def dbl_body(acc, _):
+            return self.double(acc), None
+
+        acc = jnp.broadcast_to(p, p.shape)
+        i = 1
+        while i < len(bits):
+            j = i
+            while j < len(bits) and bits[j] == "0":
+                j += 1
+            run = j - i
+            if j < len(bits):
+                run += 1
+            if run == 1:
+                acc = self.double(acc)
+            elif run > 1:
+                acc, _ = jax.lax.scan(dbl_body, acc, None, length=run)
+            if j < len(bits):
+                acc = self.add(acc, p)
+            i = j + 1
+        return acc
+
+    def mul_var_scalar(self, p, k, nbits: int = 64):
+        """k: uint64 (..., n) — per-element scalars on the minor axis."""
+        assert nbits % 2 == 0
+        p2 = self.double(p)
+        p3 = self.add(p2, p)
+        inf = jnp.broadcast_to(self.infinity, p.shape)
+        positions = jnp.arange(nbits - 2, -1, -2, dtype=jnp.uint64)
+
+        def step(acc, pos):
+            acc = self.double(self.double(acc))
+            digit = (k >> pos) & jnp.uint64(3)
+            entry = self.select(
+                digit == 1, p,
+                self.select(digit == 2, p2,
+                            self.select(digit == 3, p3, inf)),
+            )
+            return self.add(acc, entry), None
+
+        acc, _ = jax.lax.scan(step, inf, positions)
+        return acc
+
+    def msm_reduce_minor(self, pts, axis_size: int):
+        """Sum points along the MINOR batch axis (log2 complete adds);
+        result keeps a trailing batch axis of size 1."""
+        return lb.tree_reduce_minor(pts, self.add, self.infinity, axis_size)
+
+
+def _b_g1(a):
+    return FP.mul_small(a, 4)
+
+
+def _b3_g1(a):
+    return FP.mul_small(a, 12)
+
+
+def _b_g2(a):
+    return FP2.mul_small(tw.fp2_mul_by_xi(a), 4)
+
+
+def _b3_g2(a):
+    return FP2.mul_small(tw.fp2_mul_by_xi(a), 12)
+
+
+G1 = _Group(FP, _b_g1, _b3_g1, "G1")
+G2 = _Group(FP2, _b_g2, _b3_g2, "G2")
+
+
+# --- Host staging (oracle affine <-> batch-minor projective) --------------------
+
+
+def g1_from_affine(pts) -> jnp.ndarray:
+    """[(x, y) | None, ...] -> (3, L, n) batch-minor projective points."""
+    xs, ys, zs = [], [], []
+    for pt in pts:
+        if pt is None:
+            xs.append(0); ys.append(1); zs.append(0)
+        else:
+            xs.append(pt[0]); ys.append(pt[1]); zs.append(1)
+    return jnp.stack(
+        [lb.ints_to_bm(xs), lb.ints_to_bm(ys), lb.ints_to_bm(zs)], axis=0
+    )
+
+
+def g2_from_affine(pts) -> jnp.ndarray:
+    """[((x0,x1),(y0,y1)) | None, ...] -> (3, 2, L, n) batch-minor points."""
+    X, Y, Z = [], [], []
+    for pt in pts:
+        if pt is None:
+            X.append((0, 0)); Y.append((1, 0)); Z.append((0, 0))
+        else:
+            X.append(pt[0]); Y.append(pt[1]); Z.append((1, 0))
+    return jnp.stack(
+        [tw.fp2_from_int_pairs(X), tw.fp2_from_int_pairs(Y),
+         tw.fp2_from_int_pairs(Z)], axis=0
+    )
+
+
+G1_GEN = g1_from_affine([_oc.G1_GEN])
+G2_GEN = g2_from_affine([_oc.G2_GEN])
+
+
+# --- psi endomorphism, subgroup checks, cofactor clearing -----------------------
+
+_PSI_CX = tw.fp2_from_int_pairs([_oc.PSI_CX])
+_PSI_CY = tw.fp2_from_int_pairs([_oc.PSI_CY])
+
+
+def g2_psi(p):
+    X, Y, Z = G2.coords(p)
+    prod = tw.fp2_mul(
+        jnp.stack([tw.fp2_conj(X), tw.fp2_conj(Y)], axis=-4),
+        jnp.stack([jnp.broadcast_to(_PSI_CX, X.shape),
+                   jnp.broadcast_to(_PSI_CY, Y.shape)], axis=-4),
+    )
+    return G2.pack(
+        prod[..., 0, :, :, :], prod[..., 1, :, :, :], tw.fp2_conj(Z)
+    )
+
+
+def g2_in_subgroup(p):
+    s = G2.add(g2_psi(p), G2.mul_fixed_scalar(p, BLS_X_ABS))
+    return jnp.logical_and(G2.on_curve(p), G2.is_infinity(s))
+
+
+def g1_in_subgroup(p):
+    return jnp.logical_and(
+        G1.on_curve(p), G1.is_infinity(G1.mul_fixed_scalar(p, R))
+    )
+
+
+def g2_mul_by_x_abs(p):
+    return G2.mul_fixed_scalar(p, BLS_X_ABS)
+
+
+def g2_clear_cofactor(p):
+    """Budroni-Pintore psi decomposition (curves.g2_clear_cofactor)."""
+    xp = G2.neg(g2_mul_by_x_abs(p))
+    xxp = G2.neg(g2_mul_by_x_abs(xp))
+    term1 = G2.add(G2.add(xxp, G2.neg(xp)), G2.neg(p))
+    term2 = g2_psi(G2.add(xp, G2.neg(p)))
+    term3 = g2_psi(g2_psi(G2.double(p)))
+    return G2.add(G2.add(term1, term2), term3)

@@ -1,0 +1,408 @@
+"""Batch-minor extension towers: ops/tower.py's NTT-domain path re-laid out.
+
+Shapes (limb axis -2, batch minor -1):
+    Fp2  : (..., 2, L, n)
+    Fp6  : (..., 3, 2, L, n)
+    Fp12 : (..., 2, 3, 2, L, n)
+    domain Fp2  : (..., 2, n_p, NCOLS, n)
+    domain Fp6  : (..., 3, 2, n_p, NCOLS, n)
+
+Only the production path is ported: domain-schoolbook multiplies with the
+plan-3/plan-4 budgets of ops/tower.py (whose combination-bound comments are
+the proofs; sums and offsets here are term-for-term identical), bf16 domain
+storage, and the direct ops the pipeline uses. The LIGHTHOUSE_TPU_TOWER_NTT=0
+Karatsuba fallback and Pallas K3 kernels stay with the standard engine.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.bls import fields as _of
+from lighthouse_tpu.crypto.bls.constants import P
+
+from . import limbs as lb
+
+add = lb.add
+sub = lb.sub
+neg = lb.neg
+
+_DOM_BF16 = os.environ.get("LIGHTHOUSE_TPU_DOM_BF16", "1") == "1"
+
+lb.plan4()          # build eagerly, outside any trace (tower.py rationale)
+_OFF3 = lb.offset_dom3()
+_OFF4 = lb.offset_dom4()
+
+
+# --- Domain combination (components on axis -4 for Fp2, -5 for Fp6) -------------
+
+
+def _d2mul(a, b):
+    a0, a1 = (a[..., 0, :, :, :].astype(lb.DTYPE),
+              a[..., 1, :, :, :].astype(lb.DTYPE))
+    b0, b1 = (b[..., 0, :, :, :].astype(lb.DTYPE),
+              b[..., 1, :, :, :].astype(lb.DTYPE))
+    return jnp.stack([a0 * b0 - a1 * b1, a0 * b1 + a1 * b0], axis=-4)
+
+
+def _d2sqr(a):
+    a0, a1 = (a[..., 0, :, :, :].astype(lb.DTYPE),
+              a[..., 1, :, :, :].astype(lb.DTYPE))
+    p = a0 * a1
+    return jnp.stack([a0 * a0 - a1 * a1, p + p], axis=-4)
+
+
+def _dxi(a):
+    a0, a1 = (a[..., 0, :, :, :].astype(lb.DTYPE),
+              a[..., 1, :, :, :].astype(lb.DTYPE))
+    return jnp.stack([a0 - a1, a0 + a1], axis=-4)
+
+
+def _d6mul(A, B):
+    a0, a1, a2 = (A[..., 0, :, :, :, :], A[..., 1, :, :, :, :],
+                  A[..., 2, :, :, :, :])
+    b0, b1, b2 = (B[..., 0, :, :, :, :], B[..., 1, :, :, :, :],
+                  B[..., 2, :, :, :, :])
+    c0 = _d2mul(a0, b0) + _dxi(_d2mul(a1, b2) + _d2mul(a2, b1))
+    c1 = _d2mul(a0, b1) + _d2mul(a1, b0) + _dxi(_d2mul(a2, b2))
+    c2 = _d2mul(a0, b2) + _d2mul(a1, b1) + _d2mul(a2, b0)
+    return jnp.stack([c0, c1, c2], axis=-5)
+
+
+def _d6mul_by_v(A):
+    return jnp.stack(
+        [_dxi(A[..., 2, :, :, :, :]), A[..., 0, :, :, :, :],
+         A[..., 1, :, :, :, :]],
+        axis=-5,
+    )
+
+
+def _fwd3(x):
+    r = lb.ntt_fwd_lazy(x)
+    return r.astype(jnp.bfloat16) if _DOM_BF16 else r
+
+
+def _fwd4(x):
+    r = lb.ntt_fwd_lazy(x, lb.plan4())
+    return r.astype(jnp.bfloat16) if _DOM_BF16 else r
+
+
+def _out3(c):
+    return lb.ntt_dom_to_limbs(c, lb._PLAN3, _OFF3)
+
+
+def _out4(c):
+    return lb.ntt_dom_to_limbs(c, lb.plan4(), _OFF4)
+
+
+def _out4_light(c):
+    return lb.ntt_dom_to_limbs(c, lb.plan4(), _OFF4, light=True)
+
+
+# --- Fp2 ------------------------------------------------------------------------
+
+FP2_ZERO = jnp.zeros((2, lb.L, 1), dtype=lb.DTYPE)
+FP2_ONE = jnp.stack([lb.ONE_MONT, jnp.zeros((lb.L, 1), dtype=lb.DTYPE)])
+
+
+def fp2_from_int_pairs(pairs) -> jnp.ndarray:
+    """Host staging: [(c0, c1), ...] -> (2, L, n) batch-minor limbs."""
+    c0s = lb.ints_to_bm([c0 for c0, _ in pairs])
+    c1s = lb.ints_to_bm([c1 for _, c1 in pairs])
+    return jnp.stack([c0s, c1s], axis=0)
+
+
+def _fp2_const(pair):
+    return fp2_from_int_pairs([pair])
+
+
+def fp2_mul(a, b):
+    a, b = jnp.broadcast_arrays(a, b)
+    return _out3(_d2mul(_fwd3(a), _fwd3(b)))
+
+
+def fp2_sqr(a):
+    return _out3(_d2sqr(_fwd3(a)))
+
+
+def fp2_conj(a):
+    return jnp.stack([a[..., 0, :, :], lb.neg(a[..., 1, :, :])], axis=-3)
+
+
+def fp2_mul_by_xi(a):
+    a0, a1 = a[..., 0, :, :], a[..., 1, :, :]
+    return jnp.stack([lb.sub(a0, a1), lb.add(a0, a1)], axis=-3)
+
+
+def fp2_mul_fp(a, s):
+    return lb.mul(a, s[..., None, :, :])
+
+
+def fp2_inv(a):
+    a0, a1 = a[..., 0, :, :], a[..., 1, :, :]
+    sq = lb.mul(a, a)
+    norm = lb.add(sq[..., 0, :, :], sq[..., 1, :, :])
+    ninv = lb.inv(norm)
+    return lb.mul(
+        jnp.stack([a0, lb.neg(a1)], axis=-3), ninv[..., None, :, :]
+    )
+
+
+def fp2_is_zero(a):
+    return jnp.all(lb.canonicalize(a) == 0, axis=(-3, -2))
+
+
+def fp2_eq(a, b):
+    a, b = jnp.broadcast_arrays(a, b)
+    return fp2_is_zero(lb.sub(a, b))
+
+
+def fp2_select(mask, a, b):
+    return jnp.where(mask[..., None, None, :], a, b)
+
+
+def fp2_pow_fixed(a, exponent: int):
+    if exponent == 0:
+        return jnp.broadcast_to(FP2_ONE, a.shape)
+    if exponent < 16:
+        acc = a
+        for c in bin(exponent)[3:]:
+            acc = fp2_sqr(acc)
+            if c == "1":
+                acc = fp2_mul(acc, a)
+        return acc
+    digits = []
+    e = exponent
+    while e:
+        digits.append(e & 15)
+        e >>= 4
+    digits = digits[::-1]
+
+    pows = [jnp.broadcast_to(FP2_ONE, a.shape), a, fp2_sqr(a)]
+    for _ in range(13):
+        pows.append(fp2_mul(pows[-1], a))
+    table = jnp.stack(pows, axis=0)
+
+    def body(acc, digit):
+        acc = fp2_sqr(fp2_sqr(fp2_sqr(fp2_sqr(acc))))
+        return fp2_mul(acc, table[digit]), None
+
+    init = table[digits[0]]
+    ds = jnp.asarray(digits[1:], dtype=jnp.int32)
+    acc, _ = jax.lax.scan(body, init, ds)
+    return acc
+
+
+# --- sqrt_ratio (tower.py fp2_sqrt_ratio, same correction-constant table) -------
+
+_SQRT_RATIO_EXP = (P * P - 9) // 16
+_4TH_ROOTS = [(1, 0), _of.fp2_neg((1, 0)),
+              _of.fp2_pow((1, 1), (P * P - 1) // 4),
+              _of.fp2_pow((1, 1), 3 * (P * P - 1) // 4)]
+_ODD_8TH_ROOTS = [_of.fp2_pow((1, 1), j * (P * P - 1) // 8)
+                  for j in (1, 3, 5, 7)]
+from lighthouse_tpu.crypto.bls.constants import SSWU_Z2 as _Z2  # noqa: E402
+
+_K_SQUARE = [_of.fp2_sqrt(r) for r in _4TH_ROOTS]
+_K_NONSQ = [_of.fp2_sqrt(_of.fp2_mul(_Z2, _of.fp2_inv(r)))
+            for r in _ODD_8TH_ROOTS]
+assert all(k is not None for k in _K_SQUARE + _K_NONSQ)
+_K_ALL = jnp.stack([_fp2_const(k) for k in _K_SQUARE + _K_NONSQ])
+_Z2_DEV = _fp2_const(_Z2)
+
+
+def fp2_sqrt_ratio(n, d):
+    """(is_square, y): tower.fp2_sqrt_ratio re-laid out (candidate axis at
+    -4; per-element pick gathers along the minor batch axis)."""
+    d2 = fp2_sqr(d)
+    m1 = fp2_mul(jnp.stack([n, d2], axis=-4), jnp.stack([d2, d2], axis=-4))
+    nd2, d4 = m1[..., 0, :, :, :], m1[..., 1, :, :, :]
+    m2 = fp2_mul(
+        jnp.stack([nd2, d4], axis=-4),
+        jnp.stack([d, fp2_mul(nd2, d)], axis=-4),
+    )
+    nd3 = m2[..., 0, :, :, :]
+    s = m2[..., 1, :, :, :]
+    y0 = fp2_mul(nd3, fp2_pow_fixed(s, _SQRT_RATIO_EXP))
+    shape8 = y0.shape[:-3] + (8,) + y0.shape[-3:]
+    cands = fp2_mul(
+        jnp.broadcast_to(y0[..., None, :, :, :], shape8),
+        jnp.broadcast_to(_K_ALL, shape8),
+    )
+    lhs = fp2_mul(fp2_sqr(cands), d[..., None, :, :, :])
+    want_sq = n[..., None, :, :, :]
+    want_ns = fp2_mul(_Z2_DEV, n)[..., None, :, :, :]
+    good = jnp.concatenate([
+        fp2_eq(lhs[..., :4, :, :, :], want_sq),
+        fp2_eq(lhs[..., 4:, :, :, :], want_ns),
+    ], axis=-2)                                    # (..., 8, n)
+    idx = jnp.argmax(good, axis=-2)                # (..., n)
+    is_square = idx < 4
+    root = jnp.take_along_axis(
+        cands, idx[..., None, None, None, :], axis=-4
+    )[..., 0, :, :, :]
+    return is_square, root
+
+
+# --- Fp6 ------------------------------------------------------------------------
+
+FP6_ZERO = jnp.zeros((3, 2, lb.L, 1), dtype=lb.DTYPE)
+FP6_ONE = jnp.concatenate(
+    [FP2_ONE[None], jnp.zeros((2, 2, lb.L, 1), dtype=lb.DTYPE)]
+)
+
+
+def _st6(*parts):
+    return jnp.stack(parts, axis=-4)
+
+
+def fp6_mul(a, b):
+    a, b = jnp.broadcast_arrays(a, b)
+    return _out4(_d6mul(_fwd4(a), _fwd4(b)))
+
+
+def fp6_sqr(a):
+    return fp6_mul(a, a)
+
+
+def fp6_mul_by_v(a):
+    return _st6(
+        fp2_mul_by_xi(a[..., 2, :, :, :]), a[..., 0, :, :, :],
+        a[..., 1, :, :, :]
+    )
+
+
+def fp6_inv(a):
+    a0, a1, a2 = a[..., 0, :, :, :], a[..., 1, :, :, :], a[..., 2, :, :, :]
+    sq = fp2_sqr(_st6(a0, a2, a1))
+    p1 = fp2_mul(_st6(a1, a0, a0), _st6(a2, a1, a2))
+    c0 = sub(sq[..., 0, :, :, :], fp2_mul_by_xi(p1[..., 0, :, :, :]))
+    c1 = sub(fp2_mul_by_xi(sq[..., 1, :, :, :]), p1[..., 1, :, :, :])
+    c2 = sub(sq[..., 2, :, :, :], p1[..., 2, :, :, :])
+    tp = fp2_mul(_st6(a2, a1, a0), _st6(c1, c2, c0))
+    t = add(
+        fp2_mul_by_xi(add(tp[..., 0, :, :, :], tp[..., 1, :, :, :])),
+        tp[..., 2, :, :, :],
+    )
+    tinv = fp2_inv(t)
+    return fp2_mul(_st6(c0, c1, c2), tinv[..., None, :, :, :])
+
+
+# --- Fp12 -----------------------------------------------------------------------
+
+FP12_ZERO = jnp.zeros((2, 3, 2, lb.L, 1), dtype=lb.DTYPE)
+FP12_ONE = jnp.concatenate(
+    [FP6_ONE[None], jnp.zeros((1, 3, 2, lb.L, 1), dtype=lb.DTYPE)]
+)
+
+
+def _st12(c0, c1):
+    return jnp.stack([c0, c1], axis=-5)
+
+
+def fp12_mul(a, b):
+    a, b = jnp.broadcast_arrays(a, b)
+    fa, fb = _fwd4(a), _fwd4(b)
+    A0, A1 = fa[..., 0, :, :, :, :, :], fa[..., 1, :, :, :, :, :]
+    B0, B1 = fb[..., 0, :, :, :, :, :], fb[..., 1, :, :, :, :, :]
+    t0 = _d6mul(A0, B0)
+    t1 = _d6mul(A1, B1)
+    c0 = t0 + _d6mul_by_v(t1)
+    c1 = _d6mul(A0, B1) + _d6mul(A1, B0)
+    return _out4_light(jnp.stack([c0, c1], axis=-6))
+
+
+def fp12_sqr(a):
+    fa = _fwd4(a)
+    A0, A1 = fa[..., 0, :, :, :, :, :], fa[..., 1, :, :, :, :, :]
+    t0 = _d6mul(A0, A0)
+    t1 = _d6mul(A1, A1)
+    c0 = t0 + _d6mul_by_v(t1)
+    c1 = 2.0 * _d6mul(A0, A1)
+    return _out4_light(jnp.stack([c0, c1], axis=-6))
+
+
+def fp12_mul_sparse_line(a, l0, l1, l2):
+    """tower.fp12_mul_sparse_line, batch-minor (same 15-product layout)."""
+    fa = _fwd4(a)                                   # (..., 2,3,2,np,N,n)
+    fl = _fwd4(jnp.stack([l0, l1, l2], axis=-4))    # (..., 3,2,np,N,n)
+    A0, A1 = fa[..., 0, :, :, :, :, :], fa[..., 1, :, :, :, :, :]
+    d0 = fl[..., 0, :, :, :, :]
+    d1 = fl[..., 1, :, :, :, :]
+    d2 = fl[..., 2, :, :, :, :]
+    a00, a01, a02 = (A0[..., 0, :, :, :, :], A0[..., 1, :, :, :, :],
+                     A0[..., 2, :, :, :, :])
+    b0, b1, b2 = (A1[..., 0, :, :, :, :], A1[..., 1, :, :, :, :],
+                  A1[..., 2, :, :, :, :])
+    t0 = jnp.stack(
+        [_d2mul(a00, d0), _d2mul(a01, d0), _d2mul(a02, d0)], axis=-5
+    )
+    t1 = jnp.stack(
+        [_dxi(_d2mul(b1, d2) + _d2mul(b2, d1)),
+         _d2mul(b0, d1) + _dxi(_d2mul(b2, d2)),
+         _d2mul(b0, d2) + _d2mul(b1, d1)],
+        axis=-5,
+    )
+    t2 = jnp.stack(
+        [_dxi(_d2mul(a01, d2) + _d2mul(a02, d1)),
+         _d2mul(a00, d1) + _dxi(_d2mul(a02, d2)),
+         _d2mul(a00, d2) + _d2mul(a01, d1)],
+        axis=-5,
+    )
+    t3 = jnp.stack(
+        [_d2mul(b0, d0), _d2mul(b1, d0), _d2mul(b2, d0)], axis=-5
+    )
+    c0 = t0 + _d6mul_by_v(t1)
+    c1 = t2 + t3
+    return _out4_light(jnp.stack([c0, c1], axis=-6))
+
+
+def fp12_conj(a):
+    return _st12(a[..., 0, :, :, :, :], neg(a[..., 1, :, :, :, :]))
+
+
+def fp12_inv(a):
+    a0, a1 = a[..., 0, :, :, :, :], a[..., 1, :, :, :, :]
+    sq = fp6_sqr(jnp.stack([a0, a1], axis=-5))
+    t = sub(sq[..., 0, :, :, :, :], fp6_mul_by_v(sq[..., 1, :, :, :, :]))
+    tinv = fp6_inv(t)
+    res = fp6_mul(
+        jnp.stack([a0, neg(a1)], axis=-5),
+        jnp.broadcast_to(tinv[..., None, :, :, :, :], a.shape),
+    )
+    return _st12(res[..., 0, :, :, :, :], res[..., 1, :, :, :, :])
+
+
+def fp12_eq(a, b):
+    a, b = jnp.broadcast_arrays(a, b)
+    return jnp.all(
+        lb.canonicalize(lb.sub(a, b)) == 0, axis=(-2, -3, -4, -5)
+    )
+
+
+def fp12_is_one(a):
+    return fp12_eq(a, jnp.broadcast_to(FP12_ONE, a.shape))
+
+
+# Frobenius constants (fp2 coefficient axis at -3 in BM layout).
+_GAMMA1_CONSTS = jnp.stack([_fp2_const(_of._GAMMA1[j]) for j in range(6)])
+_FROB_MULT = jnp.stack(
+    [
+        jnp.stack([_GAMMA1_CONSTS[0], _GAMMA1_CONSTS[2], _GAMMA1_CONSTS[4]]),
+        jnp.stack([_GAMMA1_CONSTS[1], _GAMMA1_CONSTS[3], _GAMMA1_CONSTS[5]]),
+    ]
+)
+
+
+def fp12_frob(a):
+    conj = jnp.concatenate(
+        [a[..., 0:1, :, :], lb.neg(a[..., 1:2, :, :])], axis=-3
+    )
+    return fp2_mul(conj, jnp.broadcast_to(_FROB_MULT, a.shape))
+
+
+def fp12_frob_n(a, n: int):
+    for _ in range(n % 12):
+        a = fp12_frob(a)
+    return a
