@@ -1,0 +1,151 @@
+"""Batch-minor hash-to-curve for G2: ops/h2c.py's device map re-laid out.
+
+Host hash_to_field stays byte-identical (reused from ops/h2c.py) and is
+staged batch-minor: u tensors are (..., 2, 2, L, m) — two Fp2 elements per
+message with the message axis minor. The SSWU map, 3-isogeny and cofactor
+clearing follow ops/h2c.py step for step (its RFC 9380 derivation comments
+are authoritative)."""
+
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.bls import hash_to_curve as oh2c
+from lighthouse_tpu.crypto.bls.constants import (
+    DST_G2,
+    ISO3_X_DEN,
+    ISO3_X_NUM,
+    ISO3_Y_DEN,
+    ISO3_Y_NUM,
+    SSWU_A2,
+    SSWU_B2,
+    SSWU_Z2,
+)
+
+from . import curves as cv
+from . import limbs as lb
+from . import tower as tw
+
+_A = tw.fp2_from_int_pairs([SSWU_A2])
+_B = tw.fp2_from_int_pairs([SSWU_B2])
+_Z = tw.fp2_from_int_pairs([SSWU_Z2])
+
+
+def _stack_coeffs(coeffs):
+    return jnp.stack([tw.fp2_from_int_pairs([c]) for c in coeffs])
+
+
+_XN = _stack_coeffs(ISO3_X_NUM)
+_XD_H = _stack_coeffs(list(ISO3_X_DEN) + [(0, 0)])
+_YN = _stack_coeffs(ISO3_Y_NUM)
+_YD = _stack_coeffs(ISO3_Y_DEN)
+
+
+# --- Host staging ----------------------------------------------------------
+
+
+def hash_to_field_bm(messages, dst: bytes = DST_G2):
+    """Host SHA hash_to_field -> (2, 2, L, n) batch-minor limbs (axes:
+    element u0/u1, Fp2 component, limb, message)."""
+    us = [oh2c.hash_to_field_fp2(msg, 2, dst) for msg in messages]
+    return jnp.stack([
+        tw.fp2_from_int_pairs([u[0] for u in us]),
+        tw.fp2_from_int_pairs([u[1] for u in us]),
+    ], axis=0)
+
+
+# --- Device map ------------------------------------------------------------
+
+
+def _sgn0_fp2(a):
+    std = lb.canonicalize(a)                   # (..., 2, L, n)
+    a0, a1 = std[..., 0, :, :], std[..., 1, :, :]
+    sign0 = jnp.mod(a0[..., 0, :], 2.0) == 1.0
+    zero0 = jnp.all(a0 == 0, axis=-2)
+    sign1 = jnp.mod(a1[..., 0, :], 2.0) == 1.0
+    return jnp.logical_or(sign0, jnp.logical_and(zero0, sign1))
+
+
+def map_to_curve_sswu_projective(u):
+    """(..., 2, L, n) field elements -> (x_num, x_den, y) on E2'
+    (h2c.map_to_curve_sswu_projective, batch-minor)."""
+    tv1 = tw.fp2_mul(jnp.broadcast_to(_Z, u.shape), tw.fp2_sqr(u))
+    tv2 = lb.add(tw.fp2_sqr(tv1), tv1)
+    tv2_zero = tw.fp2_is_zero(tv2)
+    one = jnp.broadcast_to(tw.FP2_ONE, tv2.shape)
+    xn = tw.fp2_mul(jnp.broadcast_to(_B, tv2.shape), lb.add(tv2, one))
+    den_inner = tw.fp2_select(
+        tv2_zero, jnp.broadcast_to(_Z, tv2.shape), lb.neg(tv2)
+    )
+    xd = tw.fp2_mul(jnp.broadcast_to(_A, tv2.shape), den_inner)
+
+    sq = tw.fp2_sqr(jnp.stack([xn, xd], axis=-4))
+    xn2, xd2 = sq[..., 0, :, :, :], sq[..., 1, :, :, :]
+    m = tw.fp2_mul(
+        jnp.stack([xn2, xd2, xd2], axis=-4),
+        jnp.stack([xn, xd, xn], axis=-4),
+    )
+    xn3, xd3, xnxd2 = m[..., 0, :, :, :], m[..., 1, :, :, :], m[..., 2, :, :, :]
+    m2 = tw.fp2_mul(
+        jnp.stack([xnxd2, xd3], axis=-4),
+        jnp.stack([jnp.broadcast_to(_A, xd3.shape),
+                   jnp.broadcast_to(_B, xd3.shape)], axis=-4),
+    )
+    gxn = lb.add(lb.add(xn3, m2[..., 0, :, :, :]), m2[..., 1, :, :, :])
+    is_sq, y1 = tw.fp2_sqrt_ratio(gxn, xd3)
+
+    m3 = tw.fp2_mul(
+        jnp.stack([tv1, tw.fp2_mul(tv1, u)], axis=-4),
+        jnp.stack([xn, y1], axis=-4),
+    )
+    x2n, y2 = m3[..., 0, :, :, :], m3[..., 1, :, :, :]
+    xn_out = tw.fp2_select(is_sq, xn, x2n)
+    y = tw.fp2_select(is_sq, y1, y2)
+    flip = jnp.logical_xor(_sgn0_fp2(u), _sgn0_fp2(y))
+    y = tw.fp2_select(flip, lb.neg(y), y)
+    return xn_out, xd, y
+
+
+def iso_map_homogeneous(xn, xd, y):
+    """3-isogeny E2' -> E2 on a projective x (h2c.iso_map_homogeneous)."""
+    sq = tw.fp2_sqr(jnp.stack([xn, xd], axis=-4))
+    xn2, xd2 = sq[..., 0, :, :, :], sq[..., 1, :, :, :]
+    m = tw.fp2_mul(
+        jnp.stack([xn2, xd2, xn2], axis=-4),
+        jnp.stack([xn, xd, xd], axis=-4),
+    )
+    xn3, xd3, xn2xd = m[..., 0, :, :, :], m[..., 1, :, :, :], m[..., 2, :, :, :]
+    xnxd2 = tw.fp2_mul(xn, xd2)
+    basis = jnp.stack([xd3, xnxd2, xn2xd, xn3], axis=-4)
+
+    def hom_eval(coeffs):
+        shape = basis.shape
+        prod = tw.fp2_mul(jnp.broadcast_to(coeffs, shape), basis)
+        acc = prod[..., 0, :, :, :]
+        for i in range(1, coeffs.shape[0]):
+            acc = lb.add(acc, prod[..., i, :, :, :])
+        return acc
+
+    xnum = hom_eval(_XN)
+    xden = hom_eval(_XD_H)
+    ynum = hom_eval(_YN)
+    yden = hom_eval(_YD)
+    m2 = tw.fp2_mul(
+        jnp.stack([xnum, ynum, xden], axis=-4),
+        jnp.stack([yden, y, yden], axis=-4),
+    )
+    X = m2[..., 0, :, :, :]
+    yyn = m2[..., 1, :, :, :]
+    Z = m2[..., 2, :, :, :]
+    Y = tw.fp2_mul(yyn, xden)
+    return cv.G2.pack(X, Y, Z)
+
+
+def hash_to_g2_device(u):
+    """(2, 2, L, n) field elements -> (3, 2, L, n) projective G2 points."""
+    xn, xd, y = map_to_curve_sswu_projective(u)    # element axis leads
+    q = iso_map_homogeneous(xn, xd, y)             # (2, 3, 2, L, n)
+    s = cv.G2.add(q[0], q[1])
+    return cv.g2_clear_cofactor(s)
+
+
+def hash_to_g2(messages, dst: bytes = DST_G2):
+    return hash_to_g2_device(hash_to_field_bm(messages, dst))
