@@ -261,6 +261,12 @@ def _verify_tpu_impl(sets, sharded):
     n_bucket = _next_pow2(n, floor=max(1, floor_n))
     k_bucket = _next_pow2(k_max)
 
+    # Engine layout: "bm" stages batch-minor tensors (the round-5 tile-
+    # utilization re-layout, ops/bm/) on the single-chip path; the sharded
+    # path stays batch-major (its mesh shards the leading axis).
+    if _layout() == "bm" and not sharded:
+        return _verify_bm_impl(sets, n, n_bucket, k_bucket)
+
     # --- stage tensors (host ints -> device limbs) ------------------------
     # Hash-cons identical messages BEFORE the host SHA and the device h2c
     # map: a committee's unaggregated attestations share AttestationData,
@@ -309,6 +315,76 @@ def _verify_tpu_impl(sets, sharded):
         jnp.asarray(inv_idx),
         pk_proj,
         sig_proj,
+        jnp.asarray(sig_checked),
+        jnp.asarray(set_mask),
+        jnp.asarray(scalars),
+    )
+
+
+def _layout() -> str:
+    """Engine layout: "bm" | "major" | "auto" (default). Auto selects the
+    batch-minor engine on real accelerators — where its full (8, 128)
+    tiles are the point — and the batch-major engine on CPU, where the
+    test suite's warmed XLA:CPU cache and the virtual-mesh sharded paths
+    live."""
+    mode = os.environ.get("LIGHTHOUSE_TPU_LAYOUT", "auto")
+    if mode == "auto":
+        return "bm" if jax.default_backend() != "cpu" else "major"
+    return mode
+
+
+def _verify_bm_impl(sets, n, n_bucket, k_bucket):
+    """Stage the batch into batch-minor tensors and run the BM core
+    (ops/bm/backend.py). Same hash-consing, padding, and random-scalar
+    semantics as the batch-major staging above."""
+    from .bm import backend as bmb
+    from .bm import curves as bmc
+    from .bm import h2c as bmh
+
+    uniq: dict = {}
+    inv_idx = np.zeros((n_bucket,), dtype=np.int32)
+    for i, s in enumerate(sets):
+        inv_idx[i] = uniq.setdefault(bytes(s.message), len(uniq))
+    m_bucket = _next_pow2(len(uniq))
+    u = np.zeros((2, 2, lb.L, m_bucket), dtype=lb.NP_DTYPE)
+    u[..., : len(uniq)] = bmh.hash_to_field_bm_np(list(uniq.keys()))
+
+    pk_pts = []
+    for s in sets:
+        pts = [pk.point for pk in s.signing_keys]
+        pts += [None] * (k_bucket - len(pts))
+        pk_pts.extend(pts)
+    pk_pts += [None] * ((n_bucket - n) * k_bucket)
+    # Flat minor order is (set, slot) with slot fastest: split the minor
+    # axis and move the slot axis to the front -> (K, 3, L, n).
+    pk_flat = bmc.g1_from_affine_np(pk_pts)              # (3, L, n*K)
+    pk_proj = np.ascontiguousarray(np.moveaxis(
+        pk_flat.reshape(3, lb.L, n_bucket, k_bucket), -1, 0
+    ))
+
+    sig_pts = [s.signature.point for s in sets] + [None] * (n_bucket - n)
+    sig_proj = bmc.g2_from_affine_np(sig_pts)
+
+    sig_checked = np.zeros((n_bucket,), dtype=bool)
+    sig_checked[:n] = [s.signature.subgroup_checked for s in sets]
+    sig_checked[n:] = True
+
+    set_mask = np.zeros((n_bucket,), dtype=bool)
+    set_mask[:n] = True
+
+    scalars = np.ones((n_bucket,), dtype=np.uint64)
+    for i in range(n):
+        r = 0
+        while r == 0:
+            r = secrets.randbits(_RAND_BITS)
+        scalars[i] = r
+
+    core = bmb.jitted_core(n_bucket, k_bucket)
+    return core(
+        jnp.asarray(u),
+        jnp.asarray(inv_idx),
+        jnp.asarray(pk_proj),
+        jnp.asarray(sig_proj),
         jnp.asarray(sig_checked),
         jnp.asarray(set_mask),
         jnp.asarray(scalars),

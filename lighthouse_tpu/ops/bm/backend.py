@@ -1,0 +1,85 @@
+"""Batch-minor staged batch verification: ops/backend.py's device graph in
+the batch-minor layout.
+
+Same three-stage pipeline (hash-consed h2c gather -> aggregation/validity/
+random-scalar weighting -> product-of-pairings check), same blst batch
+equation and host-side early-out semantics — ops/backend.py drives the
+host staging and dispatches here when the batch-minor engine is selected
+(LIGHTHOUSE_TPU_LAYOUT). Tensors put to the device:
+
+    u         (2, 2, L, m)     distinct-message field elements, minor m
+    inv_idx   (n,) int32       set -> distinct-message row
+    pk_proj   (K, 3, L, n)     projective pubkeys (K slots, infinity-padded)
+    sig_proj  (3, 2, L, n)     projective signatures
+    sig_checked / set_mask (n,) bool ; scalars (n,) uint64
+"""
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.bls import curves as _oc
+from lighthouse_tpu.crypto.bls.constants import P as _P
+
+from . import curves as cv
+from . import h2c
+from . import limbs as lb
+from . import pairing as pr
+
+# -g1 generator, batch-minor projective with a minor batch axis of 1.
+_NEG_G1 = cv.g1_from_affine([(_oc.G1_GEN[0], _P - _oc.G1_GEN[1])])
+
+
+def _h2g2_gather(u, inv_idx):
+    """Distinct-message SSWU/isogeny/cofactor map + minor-axis gather."""
+    h_unique = h2c.hash_to_g2_device(u)            # (3, 2, L, m)
+    return jnp.take(h_unique, inv_idx, axis=-1)    # (3, 2, L, n)
+
+
+def _prepare_pairs(pk_proj, sig_proj, sig_checked, set_mask, scalars):
+    """backend._prepare_pairs batch-minor (same aggregation/validity/
+    weighting semantics)."""
+    n = sig_proj.shape[-1]
+    agg = lb.tree_reduce(
+        pk_proj, cv.G1.add, cv.G1.infinity, pk_proj.shape[0]
+    )                                               # (3, L, n)
+    agg_inf = cv.G1.is_infinity(agg)
+
+    sig_ok = jnp.logical_or(sig_checked, cv.g2_in_subgroup(sig_proj))
+
+    a_proj = cv.G1.mul_var_scalar(agg, scalars)     # (3, L, n)
+    rsig = cv.G2.mul_var_scalar(sig_proj, scalars)  # (3, 2, L, n)
+    s_proj = cv.G2.msm_reduce_minor(rsig, n)        # (3, 2, L, 1)
+
+    p_proj = jnp.concatenate([a_proj, _NEG_G1], axis=-1)
+    sets_valid = jnp.all(
+        jnp.where(set_mask, jnp.logical_and(sig_ok, ~agg_inf), True)
+    )
+    return p_proj, s_proj, sets_valid
+
+
+def _pairing_check(p_proj, h_proj, s_proj, set_mask, sets_valid):
+    q_proj = jnp.concatenate([h_proj, s_proj], axis=-1)
+    mask = jnp.concatenate([set_mask, jnp.ones((1,), dtype=bool)])
+    pairing_ok = pr.multi_pairing_check(p_proj, q_proj, mask)
+    return jnp.logical_and(pairing_ok, sets_valid)
+
+
+@lru_cache(maxsize=None)
+def jitted_core(n_bucket: int, k_bucket: int):
+    """Three separately-jitted stages (the monolithic-executable
+    serialization rationale of backend._jitted_core)."""
+    del n_bucket, k_bucket  # cache key only
+    stage1 = jax.jit(_h2g2_gather)
+    stage2 = jax.jit(_prepare_pairs)
+    stage3 = jax.jit(_pairing_check)
+
+    def core(u, inv_idx, pk_proj, sig_proj, sig_checked, set_mask, scalars):
+        h_proj = stage1(u, inv_idx)
+        p_proj, s_proj, sets_valid = stage2(
+            pk_proj, sig_proj, sig_checked, set_mask, scalars
+        )
+        return stage3(p_proj, h_proj, s_proj, set_mask, sets_valid)
+
+    return core

@@ -222,31 +222,49 @@ G2 = _Group(FP2, _b_g2, _b3_g2, "G2")
 # --- Host staging (oracle affine <-> batch-minor projective) --------------------
 
 
-def g1_from_affine(pts) -> jnp.ndarray:
-    """[(x, y) | None, ...] -> (3, L, n) batch-minor projective points."""
+def g1_from_affine_np(pts):
+    """[(x, y) | None, ...] -> (3, L, n) batch-minor points (numpy)."""
     xs, ys, zs = [], [], []
     for pt in pts:
         if pt is None:
             xs.append(0); ys.append(1); zs.append(0)
         else:
             xs.append(pt[0]); ys.append(pt[1]); zs.append(1)
-    return jnp.stack(
-        [lb.ints_to_bm(xs), lb.ints_to_bm(ys), lb.ints_to_bm(zs)], axis=0
+    import numpy as np
+    return np.stack(
+        [lb.ints_to_bm_np(xs), lb.ints_to_bm_np(ys), lb.ints_to_bm_np(zs)],
+        axis=0,
     )
 
 
-def g2_from_affine(pts) -> jnp.ndarray:
-    """[((x0,x1),(y0,y1)) | None, ...] -> (3, 2, L, n) batch-minor points."""
+def g1_from_affine(pts) -> jnp.ndarray:
+    return jnp.asarray(g1_from_affine_np(pts))
+
+
+def _fp2_stage_np(pairs):
+    import numpy as np
+    return np.stack(
+        [lb.ints_to_bm_np([c0 for c0, _ in pairs]),
+         lb.ints_to_bm_np([c1 for _, c1 in pairs])], axis=0
+    )
+
+
+def g2_from_affine_np(pts):
+    """[((x0,x1),(y0,y1)) | None, ...] -> (3, 2, L, n) batch-minor (numpy)."""
     X, Y, Z = [], [], []
     for pt in pts:
         if pt is None:
             X.append((0, 0)); Y.append((1, 0)); Z.append((0, 0))
         else:
             X.append(pt[0]); Y.append(pt[1]); Z.append((1, 0))
-    return jnp.stack(
-        [tw.fp2_from_int_pairs(X), tw.fp2_from_int_pairs(Y),
-         tw.fp2_from_int_pairs(Z)], axis=0
+    import numpy as np
+    return np.stack(
+        [_fp2_stage_np(X), _fp2_stage_np(Y), _fp2_stage_np(Z)], axis=0
     )
+
+
+def g2_from_affine(pts) -> jnp.ndarray:
+    return jnp.asarray(g2_from_affine_np(pts))
 
 
 G1_GEN = g1_from_affine([_oc.G1_GEN])

@@ -42,14 +42,20 @@ _YD = _stack_coeffs(ISO3_Y_DEN)
 # --- Host staging ----------------------------------------------------------
 
 
-def hash_to_field_bm(messages, dst: bytes = DST_G2):
-    """Host SHA hash_to_field -> (2, 2, L, n) batch-minor limbs (axes:
-    element u0/u1, Fp2 component, limb, message)."""
+def hash_to_field_bm_np(messages, dst: bytes = DST_G2):
+    """Host SHA hash_to_field -> (2, 2, L, n) batch-minor limbs (numpy;
+    axes: element u0/u1, Fp2 component, limb, message)."""
+    import numpy as np
     us = [oh2c.hash_to_field_fp2(msg, 2, dst) for msg in messages]
-    return jnp.stack([
-        tw.fp2_from_int_pairs([u[0] for u in us]),
-        tw.fp2_from_int_pairs([u[1] for u in us]),
+    return np.stack([
+        np.stack([lb.ints_to_bm_np([u[e][c] for u in us])
+                  for c in range(2)], axis=0)
+        for e in range(2)
     ], axis=0)
+
+
+def hash_to_field_bm(messages, dst: bytes = DST_G2):
+    return jnp.asarray(hash_to_field_bm_np(messages, dst))
 
 
 # --- Device map ------------------------------------------------------------

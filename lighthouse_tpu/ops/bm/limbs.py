@@ -44,13 +44,18 @@ _SQ_BIAS = _maj._SQ_BIAS
 # --- Host staging ---------------------------------------------------------------
 
 
-def ints_to_bm(xs) -> jnp.ndarray:
+def ints_to_bm_np(xs) -> np.ndarray:
     """Host staging: iterable of Python ints -> (L, n) canonical digits
-    (batch minor). Same byte-view vectorization as limbs.ints_to_mont."""
+    (batch minor, numpy). Same byte-view vectorization as
+    limbs.ints_to_mont."""
     assert B == 8
     buf = b"".join((x % P).to_bytes(L, "little") for x in xs)
     arr = np.frombuffer(buf, dtype=np.uint8).reshape(-1, L)
-    return jnp.asarray(np.ascontiguousarray(arr.T), dtype=DTYPE)
+    return np.ascontiguousarray(arr.T).astype(NP_DTYPE)
+
+
+def ints_to_bm(xs) -> jnp.ndarray:
+    return jnp.asarray(ints_to_bm_np(xs), dtype=DTYPE)
 
 
 def bm_to_ints(v) -> list:
@@ -90,16 +95,32 @@ def _passes(x, n: int):
     return x
 
 
+import os as _os
+
+# Constant-matmul formulation: "matmul" (broadcast batched jnp.matmul) or
+# "einsum" — A/B'd on chip by scripts/probe_bm.py; both contract the limb
+# axis from the left with the batch minor.
+_MM = _os.environ.get("LIGHTHOUSE_TPU_BM_MM", "matmul")
+
+
+def _matmul_const(m, x):
+    """out[..., c, n] = sum_k m[c, k] * x[..., k, n] (bf16 x bf16 -> f32
+    on the MXU); m is pre-transposed (out_cols, k)."""
+    if _MM == "einsum":
+        return jnp.einsum(
+            "ck,...kn->...cn", m, x.astype(jnp.bfloat16),
+            preferred_element_type=DTYPE,
+        )
+    return jnp.matmul(
+        m, x.astype(jnp.bfloat16), preferred_element_type=DTYPE
+    )
+
+
 def _fold_dot(hi, nrows: int):
     """(..., nrows, n) high columns x (nrows, L) fold rows -> (..., L, n),
     contracted on the MXU with the batch minor (bounds: limbs._fold_dot)."""
     rows = _T_FOLD[:nrows]
-    return jnp.einsum(
-        "rl,...rn->...ln",
-        rows.astype(jnp.bfloat16),
-        hi.astype(jnp.bfloat16),
-        preferred_element_type=DTYPE,
-    )
+    return _matmul_const(rows.T.astype(jnp.bfloat16), hi)
 
 
 def _squeeze(x):
@@ -153,13 +174,31 @@ def _inv_p_col(plan):
     return plan.inv_p_col[..., None]
 
 
+def _v_all_t(plan):
+    """(n_p*NCOLS, W_IN) transposed forward-evaluation matrix (cached on
+    the plan object; entries bf16-exact)."""
+    vt = getattr(plan, "_bm_v_all_t", None)
+    if vt is None:
+        vt = jnp.asarray(plan.v_all_np.T, dtype=jnp.bfloat16)
+        plan._bm_v_all_t = vt
+    return vt
+
+
+def _w_blocks_t(plan):
+    wt = getattr(plan, "_bm_w_blocks_t", None)
+    if wt is None:
+        wt = [
+            jnp.asarray(plan.w_np[j].T, dtype=jnp.bfloat16)
+            for j in range(plan.n_p)
+        ]
+        plan._bm_w_blocks_t = wt
+    return wt
+
+
 def ntt_fwd(x, plan=_PLAN3):
     """Squeezed digits (..., W_IN, n) -> centered residues
     (..., n_p, NCOLS, n). Bounds: limbs.ntt_fwd."""
-    e = jnp.einsum(
-        "kc,...kn->...cn", plan.v_all, x.astype(jnp.bfloat16),
-        preferred_element_type=DTYPE,
-    )
+    e = _matmul_const(_v_all_t(plan), x)
     e = e.reshape(e.shape[:-2] + (plan.n_p, NCOLS) + e.shape[-1:])
     return e - _p_col(plan) * jnp.round(e * _inv_p_col(plan))
 
@@ -187,13 +226,10 @@ def _crt_renorm(limbs):
 def _inv_gammas(prod, plan):
     """(..., n_p, NCOLS, n) centered residues -> n_p gammas (..., NCOLS, n).
     Bounds: limbs._inv_gammas (CRT weight folded into the matrices)."""
-    pb = prod.astype(jnp.bfloat16)
+    wt = _w_blocks_t(plan)
     gs = []
     for j, p in enumerate(plan.primes):
-        gj = jnp.einsum(
-            "kc,...kn->...cn", plan.w_blocks[j], pb[..., j, :, :],
-            preferred_element_type=DTYPE,
-        )
+        gj = _matmul_const(wt[j], prod[..., j, :, :])
         gs.append(gj - float(p) * jnp.round(gj * float(1.0 / p)))
     return gs
 
@@ -329,6 +365,11 @@ def eq(a, b):
 def select(mask, a, b):
     """mask (..., n) bool -> limbwise select over (..., L, n)."""
     return jnp.where(mask[..., None, :], a, b)
+
+
+# Leading-axis tree reduction (the K/pubkey axis): the standard engine's
+# implementation is layout-agnostic given a broadcastable identity.
+tree_reduce = _maj.tree_reduce
 
 
 def tree_reduce_minor(vals, combine, identity, axis_size: int):

@@ -1,0 +1,187 @@
+"""Chip A/B: batch-minor engine (ops/bm/) vs the batch-major engine.
+
+Usage: python scripts/probe_bm.py [micro|stages|e2e|all] [n ...]
+
+  micro  — dependency-chained fp2_mul / fp12_sqr loops in both layouts
+           (the tile-utilization claim, measured directly).
+  stages — the three verify stages on synthetic staged tensors at
+           (n, k=4), both layouts.
+  e2e    — pipelined verify_signature_sets_tpu_async throughput with
+           LIGHTHOUSE_TPU_LAYOUT toggled (real sets, real staging).
+
+Measurement discipline per NOTES_TPU_PERF.md: chained dependencies with a
+forced np.asarray fetch, best-of-3; the axon tunnel serves identical
+executions from cache and block_until_ready can return early.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _timed(fn, reps=3):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def micro(sizes):
+    """Dependency-chained micro A/B: each timed call feeds the previous
+    output back in (values keep evolving, so the tunnel cannot serve a
+    cached execution) and forces a full fetch at the end."""
+    import jax
+    import jax.numpy as jnp
+
+    from lighthouse_tpu.ops import limbs as lb
+    from lighthouse_tpu.ops import tower as tw
+    from lighthouse_tpu.ops.bm import tower as btw
+
+    CHAIN = 8
+
+    def run(name, f, x, ops_per_call):
+        x = f(x)
+        jax.block_until_ready(x)            # compile + warm
+        best = float("inf")
+        for _ in range(3):
+            x = f(x)                         # evolve between timings
+            np.asarray(x)
+            t0 = time.perf_counter()
+            y = f(x)
+            np.asarray(y)
+            best = min(best, time.perf_counter() - t0)
+            x = y
+        print(f"  {name}: {best*1e3:8.2f} ms  "
+              f"({ops_per_call / best / 1e3:9.1f} kops/s)")
+        return best
+
+    for n in sizes:
+        print(f"micro n={n}")
+        rng = np.random.default_rng(0)
+        digits = rng.integers(0, 256, size=(n, 2, lb.L)).astype(np.float32)
+        a_maj = jnp.asarray(digits)
+        a_bm = jnp.asarray(np.moveaxis(digits, 0, -1))
+        t1 = run("fp2_mul  major",
+                 jax.jit(lambda x: _chain(tw.fp2_mul, x, CHAIN)), a_maj,
+                 n * CHAIN)
+        t2 = run("fp2_mul  bm   ",
+                 jax.jit(lambda x: _chain(btw.fp2_mul, x, CHAIN)), a_bm,
+                 n * CHAIN)
+        print(f"  fp2_mul speedup: {t1 / t2:.2f}x")
+
+        d12 = rng.integers(0, 256, size=(n, 2, 3, 2, lb.L)).astype(np.float32)
+        f_maj = jnp.asarray(d12)
+        f_bm = jnp.asarray(np.moveaxis(d12, 0, -1))
+        t1 = run("fp12_sqr major",
+                 jax.jit(lambda x: _chain1(tw.fp12_sqr, x, CHAIN)), f_maj,
+                 n * CHAIN)
+        t2 = run("fp12_sqr bm   ",
+                 jax.jit(lambda x: _chain1(btw.fp12_sqr, x, CHAIN)), f_bm,
+                 n * CHAIN)
+        print(f"  fp12_sqr speedup: {t1 / t2:.2f}x")
+
+
+def _chain(op, x, k):
+    for _ in range(k):
+        x = op(x, x)
+    return x
+
+
+def _chain1(op, x, k):
+    for _ in range(k):
+        x = op(x)
+    return x
+
+
+def stages(sizes):
+    import jax
+    import jax.numpy as jnp
+
+    from lighthouse_tpu.ops import backend as be
+    from lighthouse_tpu.ops import curves as cv
+    from lighthouse_tpu.ops import limbs as lb
+    from lighthouse_tpu.ops.bm import backend as bmb
+    from lighthouse_tpu.ops.bm import curves as bmc
+
+    k = 4
+    for n in sizes:
+        print(f"stages n={n} k={k}")
+        # --- major
+        u = jnp.zeros((n, 2, 2, lb.L), dtype=lb.DTYPE)
+        inv_idx = jnp.arange(n, dtype=jnp.int32)
+        pk = jnp.broadcast_to(cv.G1.infinity, (n, k, 3, lb.L))
+        sig = jnp.broadcast_to(cv.G2.infinity, (n, 3, 2, lb.L))
+        chk = jnp.ones((n,), dtype=bool)
+        mask = jnp.ones((n,), dtype=bool)
+        sc = jnp.asarray(np.arange(1, n + 1, dtype=np.uint64))
+        core = be._jitted_core(n, k, False)
+        args = (u, inv_idx, pk, sig, chk, mask, sc)
+        jax.block_until_ready(core(*args))
+        t_maj = _timed(lambda: bool(core(*args)))
+        print(f"  major total: {t_maj:.3f}s -> {n / t_maj:8.1f} sigs/s")
+
+        # --- bm
+        u_bm = jnp.zeros((2, 2, lb.L, n), dtype=lb.DTYPE)
+        pk_bm = jnp.broadcast_to(bmc.G1.infinity, (k, 3, lb.L, n))
+        sig_bm = jnp.broadcast_to(bmc.G2.infinity, (3, 2, lb.L, n))
+        core_bm = bmb.jitted_core(n, k)
+        args_bm = (u_bm, inv_idx, pk_bm, sig_bm, chk, mask, sc)
+        jax.block_until_ready(core_bm(*args_bm))
+        t_bm = _timed(lambda: bool(core_bm(*args_bm)))
+        print(f"  bm    total: {t_bm:.3f}s -> {n / t_bm:8.1f} sigs/s "
+              f"({t_maj / t_bm:.2f}x)")
+
+
+def e2e(sizes):
+    import jax
+
+    from lighthouse_tpu.ops import backend as be
+    import __graft_entry__ as ge
+
+    os.environ["LIGHTHOUSE_TPU_CPU_FALLBACK_MAX"] = "0"
+    for n in sizes:
+        base = ge._example_sets(64, keys_per_set=4)
+        sets = (base * ((n + 63) // 64))[:n]
+        for layout in ("major", "bm"):
+            os.environ["LIGHTHOUSE_TPU_LAYOUT"] = layout
+            ok = be.verify_signature_sets_tpu(sets, sharded=False)
+            if not ok:
+                print(f"  e2e n={n} {layout}: FAILED VERIFY")
+                continue
+            iters = 0
+            pending = []
+            t0 = time.perf_counter()
+            while iters < 3 or time.perf_counter() - t0 < 2.0:
+                pending.append(
+                    be.verify_signature_sets_tpu_async(sets, sharded=False)
+                )
+                iters += 1
+                if iters >= 30:
+                    break
+            assert all(bool(p) for p in pending)
+            dt = time.perf_counter() - t0
+            print(f"  e2e n={n} {layout}: {n * iters / dt:8.1f} sigs/s "
+                  f"({iters} iters)")
+
+
+def main():
+    mode = sys.argv[1] if len(sys.argv) > 1 else "all"
+    sizes = [int(a) for a in sys.argv[2:]] or [1024]
+    import jax
+    print(f"devices: {jax.devices()}", file=sys.stderr)
+    if mode in ("micro", "all"):
+        micro(sizes)
+    if mode in ("stages", "all"):
+        stages(sizes)
+    if mode in ("e2e", "all"):
+        e2e(sizes)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,195 @@
+"""Differential tests for the batch-minor engine (ops/bm/) against the
+pure-Python oracle and the batch-major engine. Small shapes: the BM
+engine's production target is the real chip; these pin correctness on
+CPU at every level (limbs -> tower -> curves -> h2c -> pairing -> the
+staged verify backend)."""
+
+import random
+
+import numpy as np
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.bls import api
+from lighthouse_tpu.crypto.bls import curves as oc
+from lighthouse_tpu.crypto.bls import fields as of
+from lighthouse_tpu.crypto.bls import hash_to_curve as oh2c
+from lighthouse_tpu.crypto.bls.constants import P, R, SSWU_Z2
+from lighthouse_tpu.ops.bm import curves as cv
+from lighthouse_tpu.ops.bm import h2c
+from lighthouse_tpu.ops.bm import limbs as lb
+from lighthouse_tpu.ops.bm import pairing as pr
+from lighthouse_tpu.ops.bm import tower as tw
+
+rng = random.Random(0xB417)
+
+
+def fp2_read(a):
+    c0 = lb.bm_to_ints(a[..., 0, :, :])
+    c1 = lb.bm_to_ints(a[..., 1, :, :])
+    return list(zip(c0, c1))
+
+
+def g1_read(dev):
+    X, Y, Z = (lb.bm_to_ints(dev[i]) for i in range(3))
+    out = []
+    for x, y, z in zip(X, Y, Z):
+        if z == 0:
+            out.append(None)
+        else:
+            zi = of.fp_inv(z)
+            out.append((x * zi % P, y * zi % P))
+    return out
+
+
+def g2_read(dev):
+    cs = [[lb.bm_to_ints(dev[i][c]) for c in range(2)] for i in range(3)]
+    out = []
+    for j in range(len(cs[0][0])):
+        Z = (cs[2][0][j], cs[2][1][j])
+        if Z == (0, 0):
+            out.append(None)
+        else:
+            zi = of.fp2_inv(Z)
+            out.append((of.fp2_mul((cs[0][0][j], cs[0][1][j]), zi),
+                        of.fp2_mul((cs[1][0][j], cs[1][1][j]), zi)))
+    return out
+
+
+def test_limbs_mul_lazy_canonicalize():
+    xs = [rng.randrange(P) for _ in range(16)]
+    ys = [rng.randrange(P) for _ in range(16)]
+    a, b = lb.ints_to_bm(xs), lb.ints_to_bm(ys)
+    assert lb.bm_to_ints(lb.mul(a, b)) == [x * y % P for x, y in zip(xs, ys)]
+    lazy = lb.sub(lb.add(a, a), b)
+    assert lb.bm_to_ints(lb.sqr(lazy)) == \
+        [(2 * x - y) ** 2 % P for x, y in zip(xs, ys)]
+    assert lb.bm_to_ints(lb.canonicalize(a)) == xs
+    assert lb.bm_to_ints(lb.batch_inv(a)) == [pow(x, P - 2, P) for x in xs]
+
+
+def test_tower_fp2_fp12():
+    n = 5
+    xs2 = [(rng.randrange(P), rng.randrange(P)) for _ in range(n)]
+    ys2 = [(rng.randrange(P), rng.randrange(P)) for _ in range(n)]
+    a2, b2 = tw.fp2_from_int_pairs(xs2), tw.fp2_from_int_pairs(ys2)
+    assert fp2_read(tw.fp2_mul(a2, b2)) == \
+        [of.fp2_mul(x, y) for x, y in zip(xs2, ys2)]
+    assert fp2_read(tw.fp2_inv(a2)) == [of.fp2_inv(x) for x in xs2]
+
+    def rfp12():
+        return tuple(
+            tuple((rng.randrange(P), rng.randrange(P)) for _ in range(3))
+            for _ in range(2)
+        )
+
+    def fp12_stage(vals):
+        return jnp.stack([
+            jnp.stack([
+                tw.fp2_from_int_pairs([v[h][i] for v in vals])
+                for i in range(3)
+            ])
+            for h in range(2)
+        ])
+
+    def fp12_read(a):
+        vals = []
+        for h in range(2):
+            for i in range(3):
+                vals.append(fp2_read(a[h][i]))
+        return [
+            tuple(tuple(vals[h * 3 + i][j] for i in range(3))
+                  for h in range(2))
+            for j in range(len(vals[0]))
+        ]
+
+    xs12 = [rfp12() for _ in range(n)]
+    ys12 = [rfp12() for _ in range(n)]
+    a12, b12 = fp12_stage(xs12), fp12_stage(ys12)
+    assert fp12_read(tw.fp12_mul(a12, b12)) == \
+        [of.fp12_mul(x, y) for x, y in zip(xs12, ys12)]
+    assert fp12_read(tw.fp12_sqr(a12)) == [of.fp12_mul(x, x) for x in xs12]
+    assert fp12_read(tw.fp12_frob(a12)) == [of.fp12_frob(x) for x in xs12]
+    assert bool(np.all(np.asarray(
+        tw.fp12_is_one(tw.fp12_mul(a12, tw.fp12_inv(a12)))
+    )))
+
+
+def test_curves_group_law_and_ladders():
+    n = 6
+    g1s = [oc.g1_mul(oc.G1_GEN, rng.randrange(1, R)) for _ in range(n)]
+    g2s = [oc.g2_mul(oc.G2_GEN, rng.randrange(1, R)) for _ in range(n)]
+    P1, P2 = cv.g1_from_affine(g1s), cv.g2_from_affine(g2s)
+    assert g1_read(cv.G1.add(P1, jnp.roll(P1, 1, axis=-1))) == \
+        [oc.g1_add(a, b) for a, b in zip(g1s, g1s[-1:] + g1s[:-1])]
+    assert g2_read(cv.G2.double(P2)) == [oc.g2_add(a, a) for a in g2s]
+    inf = jnp.broadcast_to(cv.G1.infinity, P1.shape)
+    assert g1_read(cv.G1.add(P1, inf)) == g1s
+    ks = np.asarray([rng.randrange(1 << 64) for _ in range(n)],
+                    dtype=np.uint64)
+    assert g1_read(cv.G1.mul_var_scalar(P1, jnp.asarray(ks))) == \
+        [oc.g1_mul(a, int(k)) for a, k in zip(g1s, ks)]
+    assert bool(np.all(np.asarray(cv.g2_in_subgroup(P2))))
+    assert g2_read(cv.g2_clear_cofactor(P2)) == \
+        [oc.g2_clear_cofactor(a) for a in g2s]
+
+
+def test_h2c_matches_oracle():
+    msgs = [bytes([i]) * (i + 3) for i in range(4)]
+    got = g2_read(h2c.hash_to_g2(msgs))
+    assert got == [oh2c.hash_to_g2(m) for m in msgs]
+
+
+def test_pairing_batch_equation():
+    n = 4
+    ps, qs = [], []
+    for _ in range(n // 2):
+        a, b = rng.randrange(1, R), rng.randrange(1, R)
+        ps.append(oc.g1_mul(oc.G1_GEN, a))
+        qs.append(oc.g2_mul(oc.G2_GEN, b))
+        ps.append(oc.g1_mul(oc.G1_GEN, (-a * b) % R))
+        qs.append(oc.G2_GEN)
+    P1, Q2 = cv.g1_from_affine(ps), cv.g2_from_affine(qs)
+    mask = jnp.ones((n,), dtype=bool)
+    assert bool(np.asarray(pr.multi_pairing_check(P1, Q2, mask)))
+    ps[0] = oc.g1_mul(oc.G1_GEN, 7)
+    P1b = cv.g1_from_affine(ps)
+    assert not bool(np.asarray(pr.multi_pairing_check(P1b, Q2, mask)))
+    m2 = np.ones(n, dtype=bool)
+    m2[0] = m2[1] = False
+    assert bool(np.asarray(pr.multi_pairing_check(P1b, Q2, jnp.asarray(m2))))
+
+
+def test_backend_bm_verify(monkeypatch):
+    """The staged BM pipeline end to end through the public API seam:
+    valid batch, poisoned batch, mixed k, repeated messages (hash-cons)."""
+    monkeypatch.setenv("LIGHTHOUSE_TPU_LAYOUT", "bm")
+    monkeypatch.setenv("LIGHTHOUSE_TPU_CPU_FALLBACK_MAX", "0")
+    from lighthouse_tpu.ops.backend import verify_signature_sets_tpu
+
+    sks = [api.SecretKey(1000 + i) for i in range(6)]
+
+    def make(n, k, poison=None):
+        sets = []
+        for i in range(n):
+            msg = bytes([i % 3]) * 32
+            keys = [sks[(i + j) % len(sks)] for j in range(k)]
+            agg = api.AggregateSignature.aggregate(
+                [sk.sign(msg) for sk in keys]
+            )
+            sig = api.Signature.from_bytes(agg.to_bytes())
+            sets.append(api.SignatureSet(
+                signature=sig,
+                signing_keys=[sk.public_key() for sk in keys],
+                message=msg,
+            ))
+        if poison is not None:
+            bad = sets[poison]
+            sets[poison] = api.SignatureSet(
+                signature=bad.signature,
+                signing_keys=bad.signing_keys,
+                message=b"\xff" * 32,
+            )
+        return sets
+
+    assert verify_signature_sets_tpu(make(5, 2))
+    assert not verify_signature_sets_tpu(make(5, 2, poison=3))
