@@ -37,6 +37,40 @@ def _h2g2_gather(u, inv_idx):
     return jnp.take(h_unique, inv_idx, axis=-1)    # (3, 2, L, n)
 
 
+def _dual_var_ladder(p1, p2, k, nbits: int = 64):
+    """[k]P1 (G1) and [k]P2 (G2) with the SAME per-element scalars in ONE
+    2-bit-windowed scan: both groups' double-double-add steps share one
+    scan body, halving scan overhead and widening the fusion domain vs
+    two back-to-back ladders (curves._Group.mul_var_scalar semantics)."""
+    assert nbits % 2 == 0
+    g1, g2 = cv.G1, cv.G2
+    p1_2 = g1.double(p1)
+    p1_3 = g1.add(p1_2, p1)
+    p2_2 = g2.double(p2)
+    p2_3 = g2.add(p2_2, p2)
+    inf1 = jnp.broadcast_to(g1.infinity, p1.shape)
+    inf2 = jnp.broadcast_to(g2.infinity, p2.shape)
+    positions = jnp.arange(nbits - 2, -1, -2, dtype=jnp.uint64)
+
+    def step(carry, pos):
+        a1, a2 = carry
+        a1 = g1.double(g1.double(a1))
+        a2 = g2.double(g2.double(a2))
+        digit = (k >> pos) & jnp.uint64(3)
+        e1 = g1.select(
+            digit == 1, p1,
+            g1.select(digit == 2, p1_2, g1.select(digit == 3, p1_3, inf1)),
+        )
+        e2 = g2.select(
+            digit == 1, p2,
+            g2.select(digit == 2, p2_2, g2.select(digit == 3, p2_3, inf2)),
+        )
+        return (g1.add(a1, e1), g2.add(a2, e2)), None
+
+    (a1, a2), _ = jax.lax.scan(step, (inf1, inf2), positions)
+    return a1, a2
+
+
 def _prepare_pairs(pk_proj, sig_proj, sig_checked, set_mask, scalars):
     """backend._prepare_pairs batch-minor (same aggregation/validity/
     weighting semantics)."""
@@ -48,8 +82,7 @@ def _prepare_pairs(pk_proj, sig_proj, sig_checked, set_mask, scalars):
 
     sig_ok = jnp.logical_or(sig_checked, cv.g2_in_subgroup(sig_proj))
 
-    a_proj = cv.G1.mul_var_scalar(agg, scalars)     # (3, L, n)
-    rsig = cv.G2.mul_var_scalar(sig_proj, scalars)  # (3, 2, L, n)
+    a_proj, rsig = _dual_var_ladder(agg, sig_proj, scalars)
     s_proj = cv.G2.msm_reduce_minor(rsig, n)        # (3, 2, L, 1)
 
     p_proj = jnp.concatenate([a_proj, _NEG_G1], axis=-1)

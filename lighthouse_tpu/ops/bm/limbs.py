@@ -258,17 +258,33 @@ def ntt_inv_cols_fast(prod, plan=_PLAN3):
     return sum(parts)
 
 
-# Domain offsets with the trailing batch dim.
+# Domain offsets with the trailing batch dim (cached: device constants
+# must exist BEFORE any jit trace — a constant created lazily inside a
+# trace leaks that trace's buffer, the UnexpectedTracerError documented
+# at ops/tower.py's eager-constant block).
+_OFFSETS = {}
+
+
 def offset_dom3():
-    return jnp.asarray(_maj.offset_dom3_np()[..., None], dtype=DTYPE)
+    if "d3" not in _OFFSETS:
+        _OFFSETS["d3"] = jnp.asarray(
+            _maj.offset_dom3_np()[..., None], dtype=DTYPE
+        )
+    return _OFFSETS["d3"]
 
 
 def offset_dom4():
-    return jnp.asarray(_maj.offset_dom4_np()[..., None], dtype=DTYPE)
+    if "d4" not in _OFFSETS:
+        _OFFSETS["d4"] = jnp.asarray(
+            _maj.offset_dom4_np()[..., None], dtype=DTYPE
+        )
+    return _OFFSETS["d4"]
 
 
 def _offset_dom3_mul():
-    return _maj.offset_dom3_mul()[..., None]
+    if "d3m" not in _OFFSETS:
+        _OFFSETS["d3m"] = _maj.offset_dom3_mul()[..., None]
+    return _OFFSETS["d3m"]
 
 
 def ntt_dom_to_limbs(c, plan, offset_dom, light: bool = False):
@@ -426,6 +442,17 @@ def pow_fixed(a, exponent: int):
 
 def inv(a):
     return pow_fixed(a, P - 2)
+
+
+# Eager constant materialization (see the offset-cache comment above):
+# every device constant this module can reach inside a traced function is
+# built here, at import, outside any trace.
+for _plan in (_PLAN3, plan4()):
+    _v_all_t(_plan)
+    _w_blocks_t(_plan)
+offset_dom3()
+offset_dom4()
+_offset_dom3_mul()
 
 
 def batch_inv(x):
