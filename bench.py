@@ -131,6 +131,11 @@ def _shape_sweep(be) -> list:
     from lighthouse_tpu.ops import curves as cv
     from lighthouse_tpu.ops import limbs as lb
 
+    bm_layout = be._layout() == "bm"
+    if bm_layout:
+        from lighthouse_tpu.ops.bm import backend as bmb
+        from lighthouse_tpu.ops.bm import curves as bmc
+
     shapes = [
         # (n, k, distinct_messages)
         (1024, 1, 1024),
@@ -144,17 +149,25 @@ def _shape_sweep(be) -> list:
     rows = []
     for n, k, m in shapes:
         try:
-            u = jnp.zeros((m, 2, 2, lb.L), dtype=lb.DTYPE)
             inv_idx = jnp.asarray(
                 np.arange(n, dtype=np.int32) % max(m, 1)
             )
-            pk = jnp.broadcast_to(cv.G1.infinity, (n, k, 3, lb.L))
-            sig = jnp.broadcast_to(cv.G2.infinity, (n, 3, 2, lb.L))
             chk = jnp.ones((n,), dtype=bool)
             mask = jnp.ones((n,), dtype=bool)
             sc = jnp.asarray(np.arange(1, n + 1, dtype=np.uint64))
-            core = be._jitted_core(n, k, False)
-            args = (u, inv_idx, pk, sig, chk, mask, sc)
+            if bm_layout:
+                u = jnp.zeros((2, 2, lb.L, m), dtype=lb.DTYPE)
+                row_mask = jnp.ones((m,), dtype=bool)
+                pk = jnp.broadcast_to(bmc.G1.infinity, (k, 3, lb.L, n))
+                sig = jnp.broadcast_to(bmc.G2.infinity, (3, 2, lb.L, n))
+                core = bmb.jitted_core(n, k, m)
+                args = (u, inv_idx, row_mask, pk, sig, chk, mask, sc)
+            else:
+                u = jnp.zeros((m, 2, 2, lb.L), dtype=lb.DTYPE)
+                pk = jnp.broadcast_to(cv.G1.infinity, (n, k, 3, lb.L))
+                sig = jnp.broadcast_to(cv.G2.infinity, (n, 3, 2, lb.L))
+                core = be._jitted_core(n, k, False)
+                args = (u, inv_idx, pk, sig, chk, mask, sc)
             jax.block_until_ready(core(*args))          # compile + warm
             best = float("inf")
             for _ in range(3):

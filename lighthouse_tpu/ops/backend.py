@@ -348,6 +348,8 @@ def _verify_bm_impl(sets, n, n_bucket, k_bucket):
     m_bucket = _next_pow2(len(uniq))
     u = np.zeros((2, 2, lb.L, m_bucket), dtype=lb.NP_DTYPE)
     u[..., : len(uniq)] = bmh.hash_to_field_bm_np(list(uniq.keys()))
+    row_mask = np.zeros((m_bucket,), dtype=bool)
+    row_mask[: len(uniq)] = True
 
     pk_pts = []
     for s in sets:
@@ -379,10 +381,11 @@ def _verify_bm_impl(sets, n, n_bucket, k_bucket):
             r = secrets.randbits(_RAND_BITS)
         scalars[i] = r
 
-    core = bmb.jitted_core(n_bucket, k_bucket)
+    core = bmb.jitted_core(n_bucket, k_bucket, m_bucket)
     return core(
         jnp.asarray(u),
         jnp.asarray(inv_idx),
+        jnp.asarray(row_mask),
         jnp.asarray(pk_proj),
         jnp.asarray(sig_proj),
         jnp.asarray(sig_checked),

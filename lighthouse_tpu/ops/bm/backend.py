@@ -1,17 +1,33 @@
 """Batch-minor staged batch verification: ops/backend.py's device graph in
-the batch-minor layout.
+the batch-minor layout, with same-message PAIR COMBINING.
 
-Same three-stage pipeline (hash-consed h2c gather -> aggregation/validity/
-random-scalar weighting -> product-of-pairings check), same blst batch
-equation and host-side early-out semantics — ops/backend.py drives the
-host staging and dispatches here when the batch-minor engine is selected
-(LIGHTHOUSE_TPU_LAYOUT). Tensors put to the device:
+Pipeline (hash-consed h2c -> aggregation/validity/weighting + segmented
+same-message combine -> product-of-pairings over DISTINCT messages):
+
+The blst batch equation prod_i e([r_i] A_i, H(m_i)) * e(-g1, S) == 1 is
+evaluated after grouping by message: bilinearity gives
+
+    prod_{i: m_i = m} e([r_i] A_i, H(m)) = e(sum_{i: m_i = m} [r_i] A_i, H(m))
+
+so the Miller loop runs over the m DISTINCT messages (+1 signature pair)
+instead of all n sets — the exact same field value, with the per-set
+random weighting applied BEFORE combining (the anti-cancellation argument
+is unchanged set-for-set). Gossip-firehose batches (one committee's
+attestations share AttestationData; reference shape
+attestation_verification/batch.rs:187-197) collapse ~256x; all-distinct
+batches pay only a log2(n)-depth segmented scan (~11 G1 adds).
+
+Tensors put to the device:
 
     u         (2, 2, L, m)     distinct-message field elements, minor m
     inv_idx   (n,) int32       set -> distinct-message row
+    row_mask  (m,) bool        True for rows backed by a real message
     pk_proj   (K, 3, L, n)     projective pubkeys (K slots, infinity-padded)
     sig_proj  (3, 2, L, n)     projective signatures
     sig_checked / set_mask (n,) bool ; scalars (n,) uint64
+
+Same host-side early-out and poisoned-batch fallback semantics as
+ops/backend.py, which drives the staging and dispatches here.
 """
 
 from functools import lru_cache
@@ -31,10 +47,40 @@ from . import pairing as pr
 _NEG_G1 = cv.g1_from_affine([(_oc.G1_GEN[0], _P - _oc.G1_GEN[1])])
 
 
-def _h2g2_gather(u, inv_idx):
-    """Distinct-message SSWU/isogeny/cofactor map + minor-axis gather."""
-    h_unique = h2c.hash_to_g2_device(u)            # (3, 2, L, m)
-    return jnp.take(h_unique, inv_idx, axis=-1)    # (3, 2, L, n)
+def _h2g2(u):
+    """Distinct-message SSWU/isogeny/cofactor map: (2, 2, L, m) ->
+    (3, 2, L, m). No per-set gather — the pairing runs on distinct rows."""
+    return h2c.hash_to_g2_device(u)
+
+
+def _segment_combine(pts, inv_idx, m_bucket: int):
+    """Sum weighted G1 points by message id: (3, L, n) x (n,) int32 ->
+    (3, L, m_bucket) where out[j] = sum_{i: inv_idx[i] = j} pts[i].
+
+    Sort by id (gather), then an inclusive segmented scan with the
+    classical associative (value, first-of-segment flag) operator over
+    the minor axis — log2(n) complete G1 adds — and gather each
+    segment's last position (searchsorted on the sorted ids). Rows with
+    no members yield garbage gathers; the caller masks them (row_mask)."""
+    n = pts.shape[-1]
+    order = jnp.argsort(inv_idx)
+    ids = jnp.take(inv_idx, order)
+    sorted_pts = jnp.take(pts, order, axis=-1)
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), ids[1:] != ids[:-1]]
+    ).reshape(1, 1, n)
+
+    def op(a, b):
+        va, fa = a
+        vb, fb = b
+        v = cv.G1.select(fb[0, 0], vb, cv.G1.add(va, vb))
+        return v, jnp.logical_or(fa, fb)
+
+    summed, _ = jax.lax.associative_scan(op, (sorted_pts, first), axis=2)
+    last_pos = jnp.searchsorted(
+        ids, jnp.arange(m_bucket, dtype=inv_idx.dtype), side="right"
+    ) - 1
+    return jnp.take(summed, jnp.clip(last_pos, 0, n - 1), axis=-1)
 
 
 def _dual_var_ladder(p1, p2, k, nbits: int = 64):
@@ -71,48 +117,60 @@ def _dual_var_ladder(p1, p2, k, nbits: int = 64):
     return a1, a2
 
 
-def _prepare_pairs(pk_proj, sig_proj, sig_checked, set_mask, scalars):
-    """backend._prepare_pairs batch-minor (same aggregation/validity/
-    weighting semantics)."""
-    n = sig_proj.shape[-1]
-    agg = lb.tree_reduce(
-        pk_proj, cv.G1.add, cv.G1.infinity, pk_proj.shape[0]
-    )                                               # (3, L, n)
-    agg_inf = cv.G1.is_infinity(agg)
+def _make_prepare(m_bucket: int):
+    def _prepare_pairs(pk_proj, sig_proj, sig_checked, set_mask, scalars,
+                       inv_idx):
+        """Aggregation + validity + random-scalar weighting + same-message
+        combine (backend._prepare_pairs semantics, then the segmented
+        combine documented at module top)."""
+        n = sig_proj.shape[-1]
+        agg = lb.tree_reduce(
+            pk_proj, cv.G1.add, cv.G1.infinity, pk_proj.shape[0]
+        )                                               # (3, L, n)
+        agg_inf = cv.G1.is_infinity(agg)
 
-    sig_ok = jnp.logical_or(sig_checked, cv.g2_in_subgroup(sig_proj))
+        sig_ok = jnp.logical_or(sig_checked, cv.g2_in_subgroup(sig_proj))
 
-    a_proj, rsig = _dual_var_ladder(agg, sig_proj, scalars)
-    s_proj = cv.G2.msm_reduce_minor(rsig, n)        # (3, 2, L, 1)
+        a_proj, rsig = _dual_var_ladder(agg, sig_proj, scalars)
+        s_proj = cv.G2.msm_reduce_minor(rsig, n)        # (3, 2, L, 1)
 
-    p_proj = jnp.concatenate([a_proj, _NEG_G1], axis=-1)
-    sets_valid = jnp.all(
-        jnp.where(set_mask, jnp.logical_and(sig_ok, ~agg_inf), True)
-    )
-    return p_proj, s_proj, sets_valid
+        inf1 = jnp.broadcast_to(cv.G1.infinity, a_proj.shape)
+        a_masked = cv.G1.select(set_mask, a_proj, inf1)
+        a_comb = _segment_combine(a_masked, inv_idx, m_bucket)
+
+        p_proj = jnp.concatenate([a_comb, _NEG_G1], axis=-1)
+        sets_valid = jnp.all(
+            jnp.where(set_mask, jnp.logical_and(sig_ok, ~agg_inf), True)
+        )
+        return p_proj, s_proj, sets_valid
+
+    return _prepare_pairs
 
 
-def _pairing_check(p_proj, h_proj, s_proj, set_mask, sets_valid):
-    q_proj = jnp.concatenate([h_proj, s_proj], axis=-1)
-    mask = jnp.concatenate([set_mask, jnp.ones((1,), dtype=bool)])
+def _pairing_check(p_proj, h_unique, s_proj, row_mask, sets_valid):
+    """Product of pairings over the m distinct messages + the signature
+    pair (all-projective, one final exponentiation)."""
+    q_proj = jnp.concatenate([h_unique, s_proj], axis=-1)
+    mask = jnp.concatenate([row_mask, jnp.ones((1,), dtype=bool)])
     pairing_ok = pr.multi_pairing_check(p_proj, q_proj, mask)
     return jnp.logical_and(pairing_ok, sets_valid)
 
 
 @lru_cache(maxsize=None)
-def jitted_core(n_bucket: int, k_bucket: int):
+def jitted_core(n_bucket: int, k_bucket: int, m_bucket: int):
     """Three separately-jitted stages (the monolithic-executable
     serialization rationale of backend._jitted_core)."""
-    del n_bucket, k_bucket  # cache key only
-    stage1 = jax.jit(_h2g2_gather)
-    stage2 = jax.jit(_prepare_pairs)
+    del n_bucket, k_bucket  # cache keys; shapes live in the arguments
+    stage1 = jax.jit(_h2g2)
+    stage2 = jax.jit(_make_prepare(m_bucket))
     stage3 = jax.jit(_pairing_check)
 
-    def core(u, inv_idx, pk_proj, sig_proj, sig_checked, set_mask, scalars):
-        h_proj = stage1(u, inv_idx)
+    def core(u, inv_idx, row_mask, pk_proj, sig_proj, sig_checked,
+             set_mask, scalars):
+        h_unique = stage1(u)
         p_proj, s_proj, sets_valid = stage2(
-            pk_proj, sig_proj, sig_checked, set_mask, scalars
+            pk_proj, sig_proj, sig_checked, set_mask, scalars, inv_idx
         )
-        return stage3(p_proj, h_proj, s_proj, set_mask, sets_valid)
+        return stage3(p_proj, h_unique, s_proj, row_mask, sets_valid)
 
     return core

@@ -126,12 +126,13 @@ def stages(sizes):
         t_maj = _timed(lambda: bool(core(*args)))
         print(f"  major total: {t_maj:.3f}s -> {n / t_maj:8.1f} sigs/s")
 
-        # --- bm
+        # --- bm (all-distinct messages: m_bucket = n)
         u_bm = jnp.zeros((2, 2, lb.L, n), dtype=lb.DTYPE)
         pk_bm = jnp.broadcast_to(bmc.G1.infinity, (k, 3, lb.L, n))
         sig_bm = jnp.broadcast_to(bmc.G2.infinity, (3, 2, lb.L, n))
-        core_bm = bmb.jitted_core(n, k)
-        args_bm = (u_bm, inv_idx, pk_bm, sig_bm, chk, mask, sc)
+        row_mask = jnp.ones((n,), dtype=bool)
+        core_bm = bmb.jitted_core(n, k, n)
+        args_bm = (u_bm, inv_idx, row_mask, pk_bm, sig_bm, chk, mask, sc)
         jax.block_until_ready(core_bm(*args_bm))
         t_bm = _timed(lambda: bool(core_bm(*args_bm)))
         print(f"  bm    total: {t_bm:.3f}s -> {n / t_bm:8.1f} sigs/s "
