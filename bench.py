@@ -26,14 +26,15 @@ import time
 
 BLST_16CORE_ESTIMATE_SIGS_PER_SEC = 20_000.0
 
-# Batch shape: 2048 sets x 4 aggregated pubkeys. The reference caps GOSSIP
+# Batch shape: 4096 sets x 4 aggregated pubkeys. The reference caps GOSSIP
 # batches at 64 (beacon_processor/src/lib.rs:215-216) because CPU batches
 # amortize poorly against poisoning risk; the BASELINE.json eval configs
 # measure 1k/10k/100k-set batches (chain-segment replay + op-pool shapes).
-# Round-4 scaling probe: device throughput peaks at n=2048 (the 4096
-# point goes HBM-bandwidth-bound in the pairing stage, NOTES_TPU_PERF.md
-# scaling table). Override with LIGHTHOUSE_TPU_BENCH_SETS.
-N_SETS = int(os.environ.get("LIGHTHOUSE_TPU_BENCH_SETS", "2048"))
+# Round-4's knee at n=2048 (HBM-bound pairing at 4096) moved in round 5:
+# same-message pair combining caps the pairing stage at the distinct-
+# message count, so larger buckets keep amortizing (probe_bm e2e: 11.2k
+# sigs/s at 2048, 13.1k at 4096). Override with LIGHTHOUSE_TPU_BENCH_SETS.
+N_SETS = int(os.environ.get("LIGHTHOUSE_TPU_BENCH_SETS", "4096"))
 KEYS_PER_SET = 4
 N_DISTINCT = 64       # distinct sets signed on the host; tiled up to N_SETS
 TIMED_ITERS = 3

@@ -99,8 +99,19 @@ import os as _os
 
 # Constant-matmul formulation: "matmul" (broadcast batched jnp.matmul) or
 # "einsum" — A/B'd on chip by scripts/probe_bm.py; both contract the limb
-# axis from the left with the batch minor.
-_MM = _os.environ.get("LIGHTHOUSE_TPU_BM_MM", "matmul")
+# axis from the left with the batch minor. Default is platform-keyed:
+# XLA:CPU's eager thunk runtime cannot execute a BATCHED bf16 dot
+# (DotThunk "BF16 x BF16 = F32" — the same limitation behind the
+# per-prime dots in limbs._inv_gammas), so CPU uses the einsum lowering.
+def _default_mm():
+    import jax as _jax
+    try:
+        return "einsum" if _jax.default_backend() == "cpu" else "matmul"
+    except Exception:
+        return "einsum"
+
+
+_MM = _os.environ.get("LIGHTHOUSE_TPU_BM_MM", "") or _default_mm()
 
 
 def _matmul_const(m, x):

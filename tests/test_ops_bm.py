@@ -193,3 +193,16 @@ def test_backend_bm_verify(monkeypatch):
 
     assert verify_signature_sets_tpu(make(5, 2))
     assert not verify_signature_sets_tpu(make(5, 2, poison=3))
+
+    # Poison WITHIN a shared-message group (wrong signature, same message):
+    # the same-message pair combining (bm/backend._segment_combine) must
+    # still reject — the combined pair is the exact product of the
+    # per-set pairings, so one bad signature poisons its group's pair.
+    sets = make(5, 2)                       # messages: 0, 1, 2, 0, 1
+    bad = sets[3]                           # shares message 0 with set 0
+    sets[3] = api.SignatureSet(
+        signature=sets[1].signature,        # a signature over msg 1, not 0
+        signing_keys=bad.signing_keys,
+        message=bad.message,
+    )
+    assert not verify_signature_sets_tpu(sets)
