@@ -333,11 +333,12 @@ def _layout() -> str:
     return mode
 
 
-def _verify_bm_impl(sets, n, n_bucket, k_bucket):
-    """Stage the batch into batch-minor tensors and run the BM core
-    (ops/bm/backend.py). Same hash-consing, padding, and random-scalar
-    semantics as the batch-major staging above."""
-    from .bm import backend as bmb
+def stage_bm(sets, n, n_bucket, k_bucket, scalars=None):
+    """Stage a batch into batch-minor tensors (the argument tuple of
+    bm.backend.jitted_core) and return (args, m_bucket). Same
+    hash-consing, padding, and random-scalar semantics as the batch-major
+    staging above; `scalars` overrides the CSPRNG draw (deterministic
+    callers: __graft_entry__)."""
     from .bm import curves as bmc
     from .bm import h2c as bmh
 
@@ -374,15 +375,15 @@ def _verify_bm_impl(sets, n, n_bucket, k_bucket):
     set_mask = np.zeros((n_bucket,), dtype=bool)
     set_mask[:n] = True
 
-    scalars = np.ones((n_bucket,), dtype=np.uint64)
-    for i in range(n):
-        r = 0
-        while r == 0:
-            r = secrets.randbits(_RAND_BITS)
-        scalars[i] = r
+    if scalars is None:
+        scalars = np.ones((n_bucket,), dtype=np.uint64)
+        for i in range(n):
+            r = 0
+            while r == 0:
+                r = secrets.randbits(_RAND_BITS)
+            scalars[i] = r
 
-    core = bmb.jitted_core(n_bucket, k_bucket, m_bucket)
-    return core(
+    args = (
         jnp.asarray(u),
         jnp.asarray(inv_idx),
         jnp.asarray(row_mask),
@@ -392,6 +393,16 @@ def _verify_bm_impl(sets, n, n_bucket, k_bucket):
         jnp.asarray(set_mask),
         jnp.asarray(scalars),
     )
+    return args, m_bucket
+
+
+def _verify_bm_impl(sets, n, n_bucket, k_bucket):
+    """Run the batch-minor core (ops/bm/backend.py) on a staged batch."""
+    from .bm import backend as bmb
+
+    args, m_bucket = stage_bm(sets, n, n_bucket, k_bucket)
+    core = bmb.jitted_core(n_bucket, k_bucket, m_bucket)
+    return core(*args)
 
 
 # Register with the API seam (mirrors define_mod! backend instantiation,
