@@ -28,10 +28,11 @@ import numpy as np
 
 DEFAULT_SHAPE_GRID: Tuple[Tuple[int, int], ...] = (
     (64, 1), (64, 4), (256, 1), (256, 4), (1024, 1), (1024, 4),
-    # Round-4: the scaling probe peaks at n=2048 (NOTES_TPU_PERF.md);
-    # warming it lets the AdaptiveBatchPolicy's growth cap reach the
-    # peak-throughput bucket during a gossip storm.
     (2048, 1), (2048, 4),
+    # Round-5: same-message pair combining caps the pairing stage at the
+    # distinct-message count, so throughput keeps rising past the round-4
+    # n=2048 knee (NOTES_TPU_PERF.md round-5 table) — warm 4096 too.
+    (4096, 4),
 )
 
 
@@ -72,12 +73,17 @@ class ShapeWarmer:
     # -------------------------------------------------------------- warming
 
     def warm_one(self, n_bucket: int, k_bucket: int) -> None:
-        """Compile + execute one bucket shape on masked synthetic tensors."""
+        """Compile + execute one bucket shape on masked synthetic tensors
+        (whichever engine the layout selector routes this process to)."""
         import jax.numpy as jnp
 
         from lighthouse_tpu.ops import backend as be
         from lighthouse_tpu.ops import curves as cv
         from lighthouse_tpu.ops import limbs as lb
+
+        if be._layout() == "bm" and not self.sharded:
+            self._warm_one_bm(n_bucket, k_bucket)
+            return
 
         u = jnp.zeros((n_bucket, 2, 2, lb.L), dtype=lb.DTYPE)
         inv_idx = jnp.arange(n_bucket, dtype=jnp.int32)  # all-distinct shape
@@ -101,6 +107,30 @@ class ShapeWarmer:
             jax.jit(be._h2g2_gather)(
                 u_s, jnp.zeros((n_bucket,), dtype=jnp.int32)
             )
+
+    def _warm_one_bm(self, n_bucket: int, k_bucket: int) -> None:
+        """Batch-minor twin of warm_one: the all-distinct (m = n) core and
+        the hash-consed committee shape (m = n/256)."""
+        import jax.numpy as jnp
+
+        from lighthouse_tpu.ops.bm import backend as bmb
+        from lighthouse_tpu.ops.bm import curves as bmc
+        from lighthouse_tpu.ops.bm import limbs as lb
+
+        inv_idx = jnp.arange(n_bucket, dtype=jnp.int32)
+        pk_proj = jnp.broadcast_to(
+            bmc.G1.infinity, (k_bucket, 3, lb.L, n_bucket)
+        )
+        sig_proj = jnp.broadcast_to(bmc.G2.infinity, (3, 2, lb.L, n_bucket))
+        sig_checked = jnp.ones((n_bucket,), dtype=bool)
+        set_mask = jnp.zeros((n_bucket,), dtype=bool)   # all padding
+        scalars = jnp.asarray(np.ones((n_bucket,), dtype=np.uint64))
+        for m_bucket in {n_bucket, max(1, n_bucket // 256)}:
+            u = jnp.zeros((2, 2, lb.L, m_bucket), dtype=lb.DTYPE)
+            row_mask = jnp.zeros((m_bucket,), dtype=bool)
+            core = bmb.jitted_core(n_bucket, k_bucket, m_bucket)
+            core(u, inv_idx % m_bucket, row_mask, pk_proj, sig_proj,
+                 sig_checked, set_mask, scalars)
 
     def _run(self) -> None:
         for n_bucket, k_bucket in self.shapes:
