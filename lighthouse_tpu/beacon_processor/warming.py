@@ -125,7 +125,18 @@ class ShapeWarmer:
         sig_checked = jnp.ones((n_bucket,), dtype=bool)
         set_mask = jnp.zeros((n_bucket,), dtype=bool)   # all padding
         scalars = jnp.asarray(np.ones((n_bucket,), dtype=np.uint64))
-        for m_bucket in {n_bucket, max(1, n_bucket // 256)}:
+        # Every m bucket of the quantized menu (derived from the same
+        # function production staging uses): a batch whose distinct-
+        # message count lands on an unwarmed step would stall a slot
+        # third on the ~2-minute trace+lower cost. The warmer is a
+        # background daemon; the duplicate-free set below is 5 entries.
+        from lighthouse_tpu.ops.backend import _m_bucket_for
+
+        menu = {
+            _m_bucket_for(n_bucket, max(1, n_bucket >> shift))
+            for shift in (8, 6, 4, 2, 0)
+        }
+        for m_bucket in sorted(menu):
             u = jnp.zeros((2, 2, lb.L, m_bucket), dtype=lb.DTYPE)
             row_mask = jnp.zeros((m_bucket,), dtype=bool)
             core = bmb.jitted_core(n_bucket, k_bucket, m_bucket)

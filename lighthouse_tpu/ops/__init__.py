@@ -59,7 +59,17 @@ try:
     def _bounded_cache_write(cache_key, compile_time_secs, module_name,
                              backend, executable, host_callbacks,
                              *args, **kwargs):
-        if _CACHE_READONLY or compile_time_secs > _MAX_CACHE_COMPILE_SECS:
+        # The tight cap guards XLA:CPU's executable serializer (the
+        # segfault the comment above documents). Accelerator backends
+        # serialize fine and their per-stage compiles routinely run past
+        # it over the axon tunnel — capping them forced the 500k firehose
+        # probe to recompile every batch shape on every run — so they get
+        # a 10x cap instead: large enough for every production stage,
+        # still bounding a pathological monolith (a whole-pipeline jit
+        # compiles >10 min and would serialize a multi-hundred-MB entry).
+        is_cpu = getattr(backend, "platform", "cpu") == "cpu"
+        cap = _MAX_CACHE_COMPILE_SECS * (1.0 if is_cpu else 10.0)
+        if _CACHE_READONLY or compile_time_secs > cap:
             return
         return _orig_cache_write(cache_key, compile_time_secs, module_name,
                                  backend, executable, host_callbacks,

@@ -276,7 +276,14 @@ def _verify_tpu_impl(sets, sharded):
     inv_idx = np.zeros((n_bucket,), dtype=np.int32)
     for i, s in enumerate(sets):
         inv_idx[i] = uniq.setdefault(bytes(s.message), len(uniq))
-    m_bucket = _next_pow2(len(uniq), floor=max(1, floor_n))
+    # Quantized m bucket (same menu as the BM path): stage 1's jit is
+    # shaped by m, so an unquantized next-pow2 would recompile per
+    # committee count here too. Padding rows map through h2c but are
+    # never gathered (inv_idx only points at real rows). The sharded
+    # floor keeps every shard non-empty.
+    m_bucket = max(
+        _m_bucket_for(n_bucket, len(uniq)), _next_pow2(max(1, floor_n))
+    )
     u = np.zeros((m_bucket, 2, 2, lb.L), dtype=lb.NP_DTYPE)
     u_real = h2c.hash_to_field_device(list(uniq.keys()))
     u[: len(uniq)] = np.asarray(u_real)
@@ -333,6 +340,22 @@ def _layout() -> str:
     return mode
 
 
+def _m_bucket_for(n_bucket: int, n_uniq: int) -> int:
+    """Quantize the distinct-message bucket to a 5-step menu per n_bucket
+    (n/256, n/64, n/16, n/4, n). The BM core's jit key includes m_bucket
+    (stage 2 closes over it, stage 3's pair count is m+1), so an
+    unquantized m would compile a fresh graph per committee-count — the
+    500k firehose probe hit minutes-long cold compiles per batch. The
+    menu bounds graphs at 5 per (n, k); padded rows ride the row_mask
+    into the pairing as identity pairs."""
+    assert n_uniq <= n_bucket, (n_uniq, n_bucket)
+    for shift in (8, 6, 4, 2, 0):
+        m = max(1, n_bucket >> shift)
+        if n_uniq <= m:
+            return m
+    raise AssertionError("menu ends at n_bucket >= n_uniq")
+
+
 def stage_bm(sets, n, n_bucket, k_bucket, scalars=None):
     """Stage a batch into batch-minor tensors (the argument tuple of
     bm.backend.jitted_core) and return (args, m_bucket). Same
@@ -346,7 +369,7 @@ def stage_bm(sets, n, n_bucket, k_bucket, scalars=None):
     inv_idx = np.zeros((n_bucket,), dtype=np.int32)
     for i, s in enumerate(sets):
         inv_idx[i] = uniq.setdefault(bytes(s.message), len(uniq))
-    m_bucket = _next_pow2(len(uniq))
+    m_bucket = _m_bucket_for(n_bucket, len(uniq))
     u = np.zeros((2, 2, lb.L, m_bucket), dtype=lb.NP_DTYPE)
     u[..., : len(uniq)] = bmh.hash_to_field_bm_np(list(uniq.keys()))
     row_mask = np.zeros((m_bucket,), dtype=bool)

@@ -51,14 +51,21 @@ def main():
     print(f"signed {len(atts)} atts in {sign_secs:.1f}s "
           f"(shuffle {shuffle_secs:.1f}s)", file=sys.stderr)
 
-    # Warm pass on a disjoint prefix (compiles the bucket shapes without
-    # tripping the observed-attester dedup), then the timed pass.
+    # Two warm passes over disjoint thirds (disjoint: the observed-attester
+    # dedup would drop repeats), then the timed pass. Thirds make the warm
+    # and timed passes produce the SAME batch-former shapes — with one
+    # warm prefix, the timed pass's larger batches hit cold compiles and
+    # the p50 measured XLA, not the slot path (~150 s/batch per shape per
+    # process: the persistent cache skips re-optimization, but tracing +
+    # lowering a ~60k-op stage still costs ~minutes on this 1-core host;
+    # the in-client ShapeWarmer hides this behind startup).
     warm = (max_bucket,)
-    n_warm = min(max_bucket + 8, len(atts) // 4)
-    stats_warm = run_firehose(harness, atts[:n_warm],
-                              max_bucket=max_bucket, warm=warm)
-    print(f"warm pass: {stats_warm}", file=sys.stderr)
-    stats = run_firehose(harness, atts[n_warm:], max_bucket=max_bucket,
+    n3 = len(atts) // 3
+    for lo, hi in ((0, n3), (n3, 2 * n3)):
+        stats_warm = run_firehose(harness, atts[lo:hi],
+                                  max_bucket=max_bucket, warm=warm)
+        print(f"warm pass: {stats_warm}", file=sys.stderr)
+    stats = run_firehose(harness, atts[2 * n3:], max_bucket=max_bucket,
                          warm=warm)
 
     third = spec.seconds_per_slot / 3.0
