@@ -114,14 +114,44 @@ def _emit(sigs_per_sec: float, cpu_baseline: float, error: str = "",
     print(json.dumps(out))
 
 
-def _shape_sweep(be) -> list:
+def _default_sweep_shapes(cpu_only: bool) -> list:
+    """The eval-config (n, k, distinct_messages) grid, n-capped: a cold
+    8192 compile on CPU is minutes of XLA for a rung the CPU tier never
+    runs in production, so CPU sweeps stop at 4096 unless
+    LIGHTHOUSE_TPU_BENCH_SWEEP_MAX_N overrides; accelerators sweep the
+    full menu."""
+    shapes = [
+        (1024, 1, 1024),
+        (1024, 4, 1024),
+        (2048, 4, 2048),
+        (2048, 4, 64),        # hash-consed firehose shape (committees)
+        (4096, 4, 4096),
+        (1024, 64, 1024),
+        (256, 256, 256),      # mainnet aggregate k range
+        # Round-6 chunked-prep rungs (prep runs as two 4096-wide ladder
+        # slabs; pairing stays one full-width pass).
+        (8192, 4, 8192),
+        (8192, 4, 64),
+    ]
+    try:
+        max_n = int(
+            os.environ.get("LIGHTHOUSE_TPU_BENCH_SWEEP_MAX_N", "")
+            or (4096 if cpu_only else 16384)
+        )
+    except ValueError:
+        max_n = 4096 if cpu_only else 16384
+    return [s for s in shapes if s[0] <= max_n]
+
+
+def _shape_sweep(be, shapes=None) -> list:
     """Eval-config shape sweep (VERDICT r4 next #3: BASELINE configs #2/#4).
 
     Times the DEVICE pipeline at the eval shapes — the n axis (1k/2k/4k
-    per dispatch; the 10k/100k batch configs run as chunked pipelines of
-    the best bucket, reported via the chunk row), the k axis (mainnet
-    aggregates span k ~ 1..450), and the hash-consed firehose shape
-    (per-committee duplicate AttestationData -> 64 distinct messages).
+    per dispatch, plus the round-6 chunked-prep 8192 rung; the 10k/100k
+    batch configs run as chunked pipelines of the best bucket, reported
+    via the chunk row), the k axis (mainnet aggregates span k ~ 1..450),
+    and the hash-consed firehose shape (per-committee duplicate
+    AttestationData -> 64 distinct messages).
     Synthetic staged tensors: the pipeline is branch-free, so timing is
     identical for real and garbage inputs; rows are TIMING-only (the
     headline above verified a real batch end-to-end)."""
@@ -137,16 +167,8 @@ def _shape_sweep(be) -> list:
         from lighthouse_tpu.ops.bm import backend as bmb
         from lighthouse_tpu.ops.bm import curves as bmc
 
-    shapes = [
-        # (n, k, distinct_messages)
-        (1024, 1, 1024),
-        (1024, 4, 1024),
-        (2048, 4, 2048),
-        (2048, 4, 64),        # hash-consed firehose shape (committees)
-        (4096, 4, 4096),
-        (1024, 64, 1024),
-        (256, 256, 256),      # mainnet aggregate k range
-    ]
+    if shapes is None:
+        shapes = _default_sweep_shapes(jax.default_backend() == "cpu")
     rows = []
     for n, k, m in shapes:
         try:

@@ -89,8 +89,12 @@ class AdaptiveBatchPolicy:
     of a brand-new shape mid-slot; shapes warm progressively and the
     persistent cache remembers them across restarts)."""
 
-    def __init__(self, max_bucket: int = 4096, warm=(64,)):
-        self.max_bucket = max_bucket
+    def __init__(self, max_bucket: Optional[int] = None, warm=(64,)):
+        # None: resolve from the device backend's bucket menu on first
+        # use — 16384 with the round-6 chunked prep stage enabled, 4096
+        # (the monolithic-ladder knee) otherwise. Resolution is lazy so
+        # constructing a policy never forces the jax import.
+        self._max_bucket = max_bucket
         self._lock = threading.Lock()
         self.warm = set(warm)
         # Running max mirrored into a plain int: read by the processor
@@ -98,6 +102,17 @@ class AdaptiveBatchPolicy:
         # max(self.warm) could observe "Set changed size during
         # iteration"; int loads are atomic in CPython).
         self._warm_max = max(self.warm, default=1)
+
+    @property
+    def max_bucket(self) -> int:
+        if self._max_bucket is None:
+            try:
+                from lighthouse_tpu.ops.backend import max_n_bucket
+
+                self._max_bucket = max_n_bucket()
+            except Exception:
+                self._max_bucket = 4096
+        return self._max_bucket
 
     def batch_limit(self, depth: int) -> int:
         if depth < 2:

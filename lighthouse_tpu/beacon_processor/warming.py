@@ -33,6 +33,11 @@ DEFAULT_SHAPE_GRID: Tuple[Tuple[int, int], ...] = (
     # distinct-message count, so throughput keeps rising past the round-4
     # n=2048 knee (NOTES_TPU_PERF.md round-5 table) — warm 4096 too.
     (4096, 4),
+    # Round-6: the CHUNKED prep stage (ops/bm/backend.prep_chunk_width)
+    # runs these as sequences of resident-working-set ladder passes;
+    # _run skips them when chunking is disabled (ops.backend
+    # .max_n_bucket — the monolithic graphs spill past 4096).
+    (8192, 4), (16384, 4),
 )
 
 
@@ -81,7 +86,7 @@ class ShapeWarmer:
         from lighthouse_tpu.ops import curves as cv
         from lighthouse_tpu.ops import limbs as lb
 
-        if be._layout() == "bm" and not self.sharded:
+        if be._layout() == "bm":
             self._warm_one_bm(n_bucket, k_bucket)
             return
 
@@ -109,13 +114,17 @@ class ShapeWarmer:
             )
 
     def _warm_one_bm(self, n_bucket: int, k_bucket: int) -> None:
-        """Batch-minor twin of warm_one: the all-distinct (m = n) core and
-        the hash-consed committee shape (m = n/256)."""
+        """Batch-minor twin of warm_one: every m bucket of the quantized
+        menu, sharded over the mesh when the warmer is (the round-6
+        sharded path runs the BM engine too)."""
+        import jax
         import jax.numpy as jnp
 
         from lighthouse_tpu.ops.bm import backend as bmb
         from lighthouse_tpu.ops.bm import curves as bmc
         from lighthouse_tpu.ops.bm import limbs as lb
+
+        n_devices = len(jax.devices()) if self.sharded else None
 
         inv_idx = jnp.arange(n_bucket, dtype=jnp.int32)
         pk_proj = jnp.broadcast_to(
@@ -125,28 +134,50 @@ class ShapeWarmer:
         sig_checked = jnp.ones((n_bucket,), dtype=bool)
         set_mask = jnp.zeros((n_bucket,), dtype=bool)   # all padding
         scalars = jnp.asarray(np.ones((n_bucket,), dtype=np.uint64))
-        # Every m bucket of the quantized menu (derived from the same
-        # function production staging uses): a batch whose distinct-
-        # message count lands on an unwarmed step would stall a slot
-        # third on the ~2-minute trace+lower cost. The warmer is a
-        # background daemon; the duplicate-free set below is 5 entries.
-        from lighthouse_tpu.ops.backend import _m_bucket_for
+        # Every m bucket of the quantized menu (M_BUCKET_SHIFTS — the
+        # SAME constant production staging quantizes with, so the warmer
+        # cannot desync from the menu): a batch whose distinct-message
+        # count lands on an unwarmed step would stall a slot third on
+        # the ~2-minute trace+lower cost. The warmer is a background
+        # daemon; the duplicate-free set below is len(menu) entries.
+        from lighthouse_tpu.ops.backend import (
+            M_BUCKET_SHIFTS,
+            _m_bucket_for,
+            _next_pow2,
+        )
 
+        m_low = _next_pow2(max(1, n_devices or 1))
         menu = {
-            _m_bucket_for(n_bucket, max(1, n_bucket >> shift))
-            for shift in (8, 6, 4, 2, 0)
+            max(_m_bucket_for(n_bucket, max(1, n_bucket >> shift)), m_low)
+            for shift in M_BUCKET_SHIFTS
         }
         for m_bucket in sorted(menu):
             u = jnp.zeros((2, 2, lb.L, m_bucket), dtype=lb.DTYPE)
             row_mask = jnp.zeros((m_bucket,), dtype=bool)
-            core = bmb.jitted_core(n_bucket, k_bucket, m_bucket)
-            core(u, inv_idx % m_bucket, row_mask, pk_proj, sig_proj,
-                 sig_checked, set_mask, scalars)
+            args = (u, inv_idx % m_bucket, row_mask, pk_proj, sig_proj,
+                    sig_checked, set_mask, scalars)
+            if self.sharded:
+                from lighthouse_tpu.parallel import mesh as pm
+
+                mesh = pm.get_mesh(n_devices)
+                args = tuple(pm.shard_batch_minor(a, mesh) for a in args)
+            core = bmb.jitted_core(n_bucket, k_bucket, m_bucket,
+                                   sharded=self.sharded,
+                                   n_devices=n_devices)
+            core(*args)
 
     def _run(self) -> None:
+        try:
+            from lighthouse_tpu.ops.backend import max_n_bucket
+
+            n_cap = max_n_bucket()
+        except Exception:
+            n_cap = None
         for n_bucket, k_bucket in self.shapes:
             if self._stop.is_set():
                 return
+            if n_cap is not None and n_bucket > n_cap:
+                continue  # 8192/16384 rungs are gated on chunked prep
             try:
                 self.warm_one(n_bucket, k_bucket)
             except Exception:

@@ -64,9 +64,13 @@ try:
         # serialize fine and their per-stage compiles routinely run past
         # it over the axon tunnel — capping them forced the 500k firehose
         # probe to recompile every batch shape on every run — so they get
-        # a 10x cap instead: large enough for every production stage,
-        # still bounding a pathological monolith (a whole-pipeline jit
-        # compiles >10 min and would serialize a multi-hundred-MB entry).
+        # a 10x cap instead (4000 s by default): large enough for every
+        # production stage. NOTE the 10x cap alone does NOT bound a
+        # pathological monolith — a whole-pipeline jit compiling in
+        # 10-66 min still beats 4000 s and would serialize a multi-
+        # hundred-MB entry; the executable-SIZE cap on the write path
+        # below (_atomic_put, LIGHTHOUSE_TPU_JAX_CACHE_MAX_BYTES) is
+        # what bounds those on accelerators (ADVICE r5 #5).
         is_cpu = getattr(backend, "platform", "cpu") == "cpu"
         cap = _MAX_CACHE_COMPILE_SECS * (1.0 if is_cpu else 10.0)
         if _CACHE_READONLY or compile_time_secs > cap:
@@ -76,6 +80,16 @@ try:
                                  *args, **kwargs)
 
     _compiler._cache_write = _bounded_cache_write
+
+    # Executable-size cap, enforced where the serialized bytes are in
+    # hand (the LRUCache.put wrapper below): per-stage entries are a few
+    # MB on CPU and at most tens of MB on accelerators; anything beyond
+    # the cap is a monolithic whole-pipeline executable that would bloat
+    # the cache dir for a graph the staged production path never runs.
+    _MAX_CACHE_BYTES = int(
+        os.environ.get("LIGHTHOUSE_TPU_JAX_CACHE_MAX_BYTES",
+                       str(256 * 1024 * 1024))
+    )
 
     # Atomic cache writes: jax's LRUCache.put writes bytes straight to the
     # final path, so a concurrent process can read a torn multi-MB entry and
@@ -88,6 +102,8 @@ try:
         def _atomic_put(self, key, val):
             if not key:
                 raise ValueError("key cannot be empty")
+            if len(val) > _MAX_CACHE_BYTES:   # size cap (comment above)
+                return
             cache_path = self.path / f"{key}{_lru._CACHE_SUFFIX}"
             if cache_path.exists():
                 return

@@ -262,10 +262,15 @@ def _verify_tpu_impl(sets, sharded):
     k_bucket = _next_pow2(k_max)
 
     # Engine layout: "bm" stages batch-minor tensors (the round-5 tile-
-    # utilization re-layout, ops/bm/) on the single-chip path; the sharded
-    # path stays batch-major (its mesh shards the leading axis).
-    if _layout() == "bm" and not sharded:
-        return _verify_bm_impl(sets, n, n_bucket, k_bucket)
+    # utilization re-layout, ops/bm/). Since round 6 the SHARDED path runs
+    # it too — the mesh shards the trailing (minor) batch axis
+    # (parallel.mesh.minor_sharding) instead of falling back to the
+    # batch-major engine and forfeiting the ~2.4-2.9x layout win.
+    if _layout() == "bm":
+        return _verify_bm_impl(
+            sets, n, n_bucket, k_bucket, sharded=bool(sharded),
+            n_devices=len(jax.devices()) if sharded else None,
+        )
 
     # --- stage tensors (host ints -> device limbs) ------------------------
     # Hash-cons identical messages BEFORE the host SHA and the device h2c
@@ -331,37 +336,61 @@ def _verify_tpu_impl(sets, sharded):
 def _layout() -> str:
     """Engine layout: "bm" | "major" | "auto" (default). Auto selects the
     batch-minor engine on real accelerators — where its full (8, 128)
-    tiles are the point — and the batch-major engine on CPU, where the
-    test suite's warmed XLA:CPU cache and the virtual-mesh sharded paths
-    live."""
+    tiles are the point, on sharded meshes too since the minor-axis
+    sharding landed (round 6) — and the batch-major engine on CPU, where
+    the test suite's warmed XLA:CPU cache lives."""
     mode = os.environ.get("LIGHTHOUSE_TPU_LAYOUT", "auto")
     if mode == "auto":
         return "bm" if jax.default_backend() != "cpu" else "major"
     return mode
 
 
+# The distinct-message bucket menu, as shifts off n_bucket (m = n >> s):
+# n/256, n/64, n/16, n/4, n. SHARED between _m_bucket_for (staging) and
+# the ShapeWarmer's per-bucket menu walk (beacon_processor/warming.py) so
+# the warmer can never silently desync from the staging menu (ADVICE r5
+# #2). Being relative to n_bucket, the menu extends to the new chunked-
+# prep buckets (8192/16384) with no extra entries: 16384 warms
+# {64, 256, 1024, 4096, 16384}, covering the 64-committee firehose shape
+# exactly.
+M_BUCKET_SHIFTS = (8, 6, 4, 2, 0)
+
+
+def max_n_bucket() -> int:
+    """Largest production/warmed n bucket. 4096 is the measured peak
+    MONOLITHIC bucket (NOTES round-5: the prep stage's width-n ladder
+    scans spill past it); with the chunked prep stage enabled (the
+    default, ops/bm/backend.prep_chunk_width) larger buckets run as
+    fixed-width ladder passes and the menu extends to 16384."""
+    from .bm.backend import prep_chunk_width
+
+    return 16384 if prep_chunk_width(16384) else 4096
+
+
 def _m_bucket_for(n_bucket: int, n_uniq: int) -> int:
-    """Quantize the distinct-message bucket to a 5-step menu per n_bucket
-    (n/256, n/64, n/16, n/4, n). The BM core's jit key includes m_bucket
-    (stage 2 closes over it, stage 3's pair count is m+1), so an
-    unquantized m would compile a fresh graph per committee-count — the
-    500k firehose probe hit minutes-long cold compiles per batch. The
-    menu bounds graphs at 5 per (n, k); padded rows ride the row_mask
-    into the pairing as identity pairs."""
+    """Quantize the distinct-message bucket to the M_BUCKET_SHIFTS menu
+    per n_bucket. The BM core's jit key includes m_bucket (stage 2 closes
+    over it, stage 3's pair count is m+1), so an unquantized m would
+    compile a fresh graph per committee-count — the 500k firehose probe
+    hit minutes-long cold compiles per batch. The menu bounds graphs at
+    len(M_BUCKET_SHIFTS) per (n, k); padded rows ride the row_mask into
+    the pairing as identity pairs."""
     assert n_uniq <= n_bucket, (n_uniq, n_bucket)
-    for shift in (8, 6, 4, 2, 0):
+    for shift in M_BUCKET_SHIFTS:
         m = max(1, n_bucket >> shift)
         if n_uniq <= m:
             return m
     raise AssertionError("menu ends at n_bucket >= n_uniq")
 
 
-def stage_bm(sets, n, n_bucket, k_bucket, scalars=None):
+def stage_bm(sets, n, n_bucket, k_bucket, scalars=None, m_floor: int = 1):
     """Stage a batch into batch-minor tensors (the argument tuple of
     bm.backend.jitted_core) and return (args, m_bucket). Same
     hash-consing, padding, and random-scalar semantics as the batch-major
     staging above; `scalars` overrides the CSPRNG draw (deterministic
-    callers: __graft_entry__)."""
+    callers: __graft_entry__); `m_floor` bounds the distinct-message
+    bucket from below (sharded meshes: every shard of the minor m axis
+    must be non-empty)."""
     from .bm import curves as bmc
     from .bm import h2c as bmh
 
@@ -369,7 +398,9 @@ def stage_bm(sets, n, n_bucket, k_bucket, scalars=None):
     inv_idx = np.zeros((n_bucket,), dtype=np.int32)
     for i, s in enumerate(sets):
         inv_idx[i] = uniq.setdefault(bytes(s.message), len(uniq))
-    m_bucket = _m_bucket_for(n_bucket, len(uniq))
+    m_bucket = max(
+        _m_bucket_for(n_bucket, len(uniq)), _next_pow2(max(1, m_floor))
+    )
     u = np.zeros((2, 2, lb.L, m_bucket), dtype=lb.NP_DTYPE)
     u[..., : len(uniq)] = bmh.hash_to_field_bm_np(list(uniq.keys()))
     row_mask = np.zeros((m_bucket,), dtype=bool)
@@ -419,12 +450,25 @@ def stage_bm(sets, n, n_bucket, k_bucket, scalars=None):
     return args, m_bucket
 
 
-def _verify_bm_impl(sets, n, n_bucket, k_bucket):
-    """Run the batch-minor core (ops/bm/backend.py) on a staged batch."""
+def _verify_bm_impl(sets, n, n_bucket, k_bucket, sharded: bool = False,
+                    n_devices: Optional[int] = None):
+    """Run the batch-minor core (ops/bm/backend.py) on a staged batch.
+    `sharded` places every staged tensor with its trailing (minor) batch
+    axis sharded over the mesh and compiles the mesh-constrained core."""
     from .bm import backend as bmb
 
-    args, m_bucket = stage_bm(sets, n, n_bucket, k_bucket)
-    core = bmb.jitted_core(n_bucket, k_bucket, m_bucket)
+    m_floor = 1
+    if sharded:
+        n_devices = n_devices or len(jax.devices())
+        m_floor = _next_pow2(max(1, n_devices))
+    args, m_bucket = stage_bm(sets, n, n_bucket, k_bucket, m_floor=m_floor)
+    if sharded:
+        from lighthouse_tpu.parallel import mesh as pm
+
+        mesh = pm.get_mesh(n_devices)
+        args = tuple(pm.shard_batch_minor(a, mesh) for a in args)
+    core = bmb.jitted_core(n_bucket, k_bucket, m_bucket, sharded=sharded,
+                           n_devices=n_devices)
     return core(*args)
 
 

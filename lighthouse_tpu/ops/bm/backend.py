@@ -30,7 +30,9 @@ Same host-side early-out and poisoned-batch fallback semantics as
 ops/backend.py, which drives the staging and dispatches here.
 """
 
+import os
 from functools import lru_cache
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -117,31 +119,99 @@ def _dual_var_ladder(p1, p2, k, nbits: int = 64):
     return a1, a2
 
 
-def _make_prepare(m_bucket: int):
-    def _prepare_pairs(pk_proj, sig_proj, sig_checked, set_mask, scalars,
-                       inv_idx):
-        """Aggregation + validity + random-scalar weighting + same-message
-        combine (backend._prepare_pairs semantics, then the segmented
-        combine documented at module top)."""
-        n = sig_proj.shape[-1]
+# Default fixed chunk width for the CHUNKED prep stage. 4096 is the
+# measured peak monolithic bucket (NOTES round-5 table): buckets up to
+# 4096 keep the single-pass graph; 8192/16384 run as 2/4 ladder passes
+# whose per-element outputs are reassembled bit-exactly (see
+# _make_prepare). Override with LIGHTHOUSE_TPU_PREP_CHUNK (0 disables
+# chunking entirely — every bucket stays monolithic).
+DEFAULT_PREP_CHUNK = 4096
+
+
+def prep_chunk_width(n_bucket: int, n_devices: int = 1) -> int:
+    """Resolve the prep-stage chunk width for an n_bucket: 0 = monolithic,
+    otherwise a power-of-two GLOBAL width dividing n_bucket. Under a
+    sharded mesh the configured width is PER DEVICE (each chunk keeps a
+    resident `width` slab on every chip), so the global chunk scales with
+    the device count."""
+    try:
+        base = int(os.environ.get("LIGHTHOUSE_TPU_PREP_CHUNK", "")
+                   or DEFAULT_PREP_CHUNK)
+    except ValueError:
+        base = DEFAULT_PREP_CHUNK
+    if base <= 0:
+        return 0
+    width = base * max(1, int(n_devices))
+    if width >= n_bucket or n_bucket % width:
+        return 0
+    return width
+
+
+def _make_prepare(m_bucket: int, prep_chunk: int = 0):
+    """Build stage 2 (aggregation + validity + random-scalar weighting +
+    same-message combine — backend._prepare_pairs semantics, then the
+    segmented combine documented at module top).
+
+    prep_chunk > 0 runs the LADDER BLOCK — the subgroup checks and the
+    fused dual scalar ladder, the two 64-step width-n scans whose working
+    set spills past n=4096 — as a lax.scan over n/prep_chunk fixed-width
+    slabs. Every per-element value (weighted aggregate pubkeys, weighted
+    signatures, validity bits) is BIT-IDENTICAL to the monolithic pass:
+    the ladders are elementwise along the minor axis, chunk outputs are
+    restacked into the full-width tensors, and the cross-element
+    reductions (signature tree-sum, segment combine) then run exactly as
+    in the monolithic graph. tests/test_ops_bm.py pins this
+    differentially."""
+
+    def _ladder_block(pk_proj, sig_proj, sig_checked, set_mask, scalars):
         agg = lb.tree_reduce(
             pk_proj, cv.G1.add, cv.G1.infinity, pk_proj.shape[0]
-        )                                               # (3, L, n)
+        )                                               # (3, L, c)
         agg_inf = cv.G1.is_infinity(agg)
-
         sig_ok = jnp.logical_or(sig_checked, cv.g2_in_subgroup(sig_proj))
-
         a_proj, rsig = _dual_var_ladder(agg, sig_proj, scalars)
-        s_proj = cv.G2.msm_reduce_minor(rsig, n)        # (3, 2, L, 1)
-
         inf1 = jnp.broadcast_to(cv.G1.infinity, a_proj.shape)
         a_masked = cv.G1.select(set_mask, a_proj, inf1)
-        a_comb = _segment_combine(a_masked, inv_idx, m_bucket)
+        ok = jnp.where(set_mask, jnp.logical_and(sig_ok, ~agg_inf), True)
+        return a_masked, rsig, ok
 
+    def _prepare_pairs(pk_proj, sig_proj, sig_checked, set_mask, scalars,
+                       inv_idx):
+        n = sig_proj.shape[-1]
+        if prep_chunk and prep_chunk < n:
+            n_chunks = n // prep_chunk
+
+            def split(x):
+                """(..., n) -> (n_chunks, ..., c): the minor axis splits
+                chunk-major (element i -> chunk i // c, lane i % c)."""
+                y = x.reshape(x.shape[:-1] + (n_chunks, prep_chunk))
+                return jnp.moveaxis(y, -2, 0)
+
+            def join(y):
+                return jnp.moveaxis(y, 0, -2).reshape(
+                    y.shape[1:-1] + (n,)
+                )
+
+            def body(carry, xs):
+                return carry, _ladder_block(*xs)
+
+            _, (a_chunks, r_chunks, ok_chunks) = jax.lax.scan(
+                body, None,
+                (split(pk_proj), split(sig_proj), split(sig_checked),
+                 split(set_mask), split(scalars)),
+            )
+            a_masked = join(a_chunks)
+            rsig = join(r_chunks)
+            ok = join(ok_chunks)
+        else:
+            a_masked, rsig, ok = _ladder_block(
+                pk_proj, sig_proj, sig_checked, set_mask, scalars
+            )
+
+        s_proj = cv.G2.msm_reduce_minor(rsig, n)        # (3, 2, L, 1)
+        a_comb = _segment_combine(a_masked, inv_idx, m_bucket)
         p_proj = jnp.concatenate([a_comb, _NEG_G1], axis=-1)
-        sets_valid = jnp.all(
-            jnp.where(set_mask, jnp.logical_and(sig_ok, ~agg_inf), True)
-        )
+        sets_valid = jnp.all(ok)
         return p_proj, s_proj, sets_valid
 
     return _prepare_pairs
@@ -156,14 +226,72 @@ def _pairing_check(p_proj, h_unique, s_proj, row_mask, sets_valid):
     return jnp.logical_and(pairing_ok, sets_valid)
 
 
+# Stage 1/3 jits are MODULE-LEVEL singletons: their graphs depend only on
+# the distinct-message bucket m (stage 1 maps u, stage 3 pairs m+1 rows),
+# so sharing one jit wrapper across every (n, k) core lets jax's own
+# executable cache dedupe them — the warm grid compiles each m once
+# instead of once per bucket shape.
+_stage1_jit = jax.jit(_h2g2)
+_stage3_jit = jax.jit(_pairing_check)
+
+
 @lru_cache(maxsize=None)
-def jitted_core(n_bucket: int, k_bucket: int, m_bucket: int):
+def _prepare_jit(m_bucket: int, prep_chunk: int):
+    return jax.jit(_make_prepare(m_bucket, prep_chunk))
+
+
+def jitted_core(n_bucket: int, k_bucket: int, m_bucket: int,
+                prep_chunk: Optional[int] = None, sharded: bool = False,
+                n_devices: Optional[int] = None):
     """Three separately-jitted stages (the monolithic-executable
-    serialization rationale of backend._jitted_core)."""
+    serialization rationale of backend._jitted_core).
+
+    prep_chunk: fixed chunk width for the prep-stage ladder scans (None =
+    resolve from LIGHTHOUSE_TPU_PREP_CHUNK / the 4096 default; 0 =
+    monolithic). sharded: constrain stage 1/2 inputs to the mesh's
+    MINOR-axis sharding (the BM layout's batch axis is the last axis) over
+    `n_devices` devices (default: all)."""
+    if prep_chunk is None:
+        prep_chunk = prep_chunk_width(
+            n_bucket,
+            (n_devices or len(jax.devices())) if sharded else 1,
+        )
+    return _jitted_core(n_bucket, k_bucket, m_bucket, int(prep_chunk),
+                        bool(sharded), n_devices)
+
+
+@lru_cache(maxsize=None)
+def _jitted_core(n_bucket: int, k_bucket: int, m_bucket: int,
+                 prep_chunk: int, sharded: bool,
+                 n_devices: Optional[int]):
     del n_bucket, k_bucket  # cache keys; shapes live in the arguments
-    stage1 = jax.jit(_h2g2)
-    stage2 = jax.jit(_make_prepare(m_bucket))
-    stage3 = jax.jit(_pairing_check)
+    if not sharded:
+        stage1 = _stage1_jit
+        stage2 = _prepare_jit(m_bucket, prep_chunk)
+        stage3 = _stage3_jit
+    else:
+        from lighthouse_tpu.parallel import mesh as pm
+
+        def constrained(fn):
+            def wrapped(*args):
+                mesh = pm.get_mesh(n_devices)
+                args = [
+                    jax.lax.with_sharding_constraint(
+                        x, pm.minor_sharding(mesh, x.ndim)
+                    )
+                    if hasattr(x, "ndim") and x.ndim >= 1 else x
+                    for x in args
+                ]
+                return fn(*args)
+            return wrapped
+
+        # No fused.disabled() here: the BM stages are pure XLA (no Pallas
+        # kernels), so every op partitions under the mesh. Stage 3's
+        # m+1 pair axis is indivisible — leave its layout to XLA, as the
+        # major sharded path does.
+        stage1 = jax.jit(constrained(_h2g2))
+        stage2 = jax.jit(constrained(_make_prepare(m_bucket, prep_chunk)))
+        stage3 = jax.jit(_pairing_check)
 
     def core(u, inv_idx, row_mask, pk_proj, sig_proj, sig_checked,
              set_mask, scalars):
@@ -173,4 +301,5 @@ def jitted_core(n_bucket: int, k_bucket: int, m_bucket: int):
         )
         return stage3(p_proj, h_unique, s_proj, row_mask, sets_valid)
 
+    core.stages = (stage1, stage2, stage3)
     return core

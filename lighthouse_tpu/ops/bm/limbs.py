@@ -111,13 +111,25 @@ def _default_mm():
         return "einsum"
 
 
-_MM = _os.environ.get("LIGHTHOUSE_TPU_BM_MM", "") or _default_mm()
+# Resolved LAZILY on the first _matmul_const call (ADVICE r5 #1): reading
+# jax.default_backend() at import time both forced backend initialization
+# on import and froze a stale choice when the platform was selected after
+# `import lighthouse_tpu.ops.bm` — on CPU the frozen "matmul" path then
+# hit the batched-bf16 DotThunk failure at runtime.
+_MM = None
+
+
+def _mm_mode() -> str:
+    global _MM
+    if _MM is None:
+        _MM = _os.environ.get("LIGHTHOUSE_TPU_BM_MM", "") or _default_mm()
+    return _MM
 
 
 def _matmul_const(m, x):
     """out[..., c, n] = sum_k m[c, k] * x[..., k, n] (bf16 x bf16 -> f32
     on the MXU); m is pre-transposed (out_cols, k)."""
-    if _MM == "einsum":
+    if _mm_mode() == "einsum":
         return jnp.einsum(
             "ck,...kn->...cn", m, x.astype(jnp.bfloat16),
             preferred_element_type=DTYPE,

@@ -153,16 +153,25 @@ def final_exponentiation(f):
     return tw.fp12_mul(g3, t)
 
 
-def multi_pairing_is_one_proj(p_proj, q_proj, mask):
-    """prod_{i: mask} e(P_i, Q_i) == 1 with the pair axis MINOR:
-    p (3, L, n), q (3, 2, L, n), mask (n,) -> () bool."""
+def multi_pairing_product_proj(p_proj, q_proj, mask):
+    """prod_{i: mask} e(P_i, Q_i) with the pair axis MINOR:
+    p (3, L, n), q (3, 2, L, n), mask (n,) -> raw Fp12 (final-exponentiated,
+    trailing batch axis of 1). Renamed from multi_pairing_is_one_proj
+    (ADVICE r5 #3): that name returns a BOOL in the major engine, and a
+    caller porting code between engines would treat this truthy array as
+    the check result."""
     f = miller_loop_proj(p_proj, q_proj)
     f = jnp.where(mask, f, jnp.broadcast_to(tw.FP12_ONE, f.shape))
     prod = lb.tree_reduce_minor(f, tw.fp12_mul, tw.FP12_ONE, f.shape[-1])
     return final_exponentiation(prod)
 
 
-def multi_pairing_check(p_proj, q_proj, mask):
-    return tw.fp12_is_one(multi_pairing_is_one_proj(p_proj, q_proj, mask))[
-        ..., 0
-    ]
+def multi_pairing_is_one_proj(p_proj, q_proj, mask):
+    """prod_{i: mask} e(P_i, Q_i) == 1 -> () bool — the major engine's
+    (ops/pairing.py) contract, so code ports between engines unchanged."""
+    return tw.fp12_is_one(
+        multi_pairing_product_proj(p_proj, q_proj, mask)
+    )[..., 0]
+
+
+multi_pairing_check = multi_pairing_is_one_proj

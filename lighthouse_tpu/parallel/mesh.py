@@ -29,8 +29,23 @@ def get_mesh(n_devices: Optional[int] = None) -> Mesh:
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Shard the leading (set/pair) axis, replicate everything trailing."""
+    """Shard the leading (set/pair) axis, replicate everything trailing.
+    This is the BATCH-MAJOR engine's layout (ops/*.py: batch leads)."""
     return NamedSharding(mesh, PartitionSpec(BATCH_AXIS))
+
+
+def minor_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Shard the TRAILING (lane) axis, replicate everything leading.
+
+    The batch-minor engine (ops/bm/) puts the batch on the LAST axis of
+    every tensor — (..., L, n) field elements, (..., 3, L, n) G1 points —
+    so data parallelism over the signature-set axis shards the minor
+    axis. PartitionSpec is positional, so the spec depends on the array
+    rank; callers pass each array's ndim."""
+    assert ndim >= 1, ndim
+    return NamedSharding(
+        mesh, PartitionSpec(*((None,) * (ndim - 1)), BATCH_AXIS)
+    )
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -43,3 +58,12 @@ def shard_batch(arr, mesh: Optional[Mesh] = None):
     buckets >= the device count)."""
     mesh = mesh or get_mesh()
     return jax.device_put(arr, batch_sharding(mesh))
+
+
+def shard_batch_minor(arr, mesh: Optional[Mesh] = None):
+    """Place `arr` with its TRAILING axis sharded across the mesh (the
+    batch-minor engine's batch axis). The trailing dim must be divisible
+    by the mesh size (BM staging floors both the n and m buckets at the
+    device count)."""
+    mesh = mesh or get_mesh()
+    return jax.device_put(arr, minor_sharding(mesh, arr.ndim))

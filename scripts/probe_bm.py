@@ -1,6 +1,6 @@
 """Chip A/B: batch-minor engine (ops/bm/) vs the batch-major engine.
 
-Usage: python scripts/probe_bm.py [micro|stages|e2e|all] [n ...]
+Usage: python scripts/probe_bm.py [micro|stages|e2e|chunk|all] [n ...]
 
   micro  — dependency-chained fp2_mul / fp12_sqr loops in both layouts
            (the tile-utilization claim, measured directly).
@@ -8,6 +8,11 @@ Usage: python scripts/probe_bm.py [micro|stages|e2e|all] [n ...]
            (n, k=4), both layouts.
   e2e    — pipelined verify_signature_sets_tpu_async throughput with
            LIGHTHOUSE_TPU_LAYOUT toggled (real sets, real staging).
+  chunk  — prep-stage A/B at (n, k=4): monolithic ladder vs the round-6
+           chunked ladder passes (lax.scan over fixed-width slabs); run
+           with n 8192 16384 on a chip to size the new bucket rungs.
+           ("chunk" is not in "all": the monolithic 8192 graph can spill
+           hard enough to OOM a small chip — run it deliberately.)
 
 Measurement discipline per NOTES_TPU_PERF.md: chained dependencies with a
 forced np.asarray fetch, best-of-3; the axon tunnel serves identical
@@ -139,6 +144,53 @@ def stages(sizes):
               f"({t_maj / t_bm:.2f}x)")
 
 
+def chunk(sizes):
+    """Prep-chunk A/B: stage-2 (the ladder stage chunking targets) and
+    whole-core timings at (n, k=4, all-distinct m), monolithic
+    (prep_chunk=0) vs the resolved chunk width. Bit-exactness is pinned
+    in tests/test_ops_bm.py; this measures the spill-vs-scan tradeoff."""
+    import jax
+    import jax.numpy as jnp
+
+    from lighthouse_tpu.ops.bm import backend as bmb
+    from lighthouse_tpu.ops.bm import curves as bmc
+    from lighthouse_tpu.ops.bm import limbs as lb
+
+    k = 4
+    for n in sizes:
+        width = bmb.prep_chunk_width(n)
+        print(f"chunk n={n} k={k} (resolved width {width or 'monolithic'})")
+        u = jnp.zeros((2, 2, lb.L, n), dtype=lb.DTYPE)
+        inv_idx = jnp.arange(n, dtype=jnp.int32)
+        row_mask = jnp.ones((n,), dtype=bool)
+        pk = jnp.broadcast_to(bmc.G1.infinity, (k, 3, lb.L, n))
+        sig = jnp.broadcast_to(bmc.G2.infinity, (3, 2, lb.L, n))
+        chk = jnp.ones((n,), dtype=bool)
+        mask = jnp.ones((n,), dtype=bool)
+        sc = jnp.asarray(np.arange(1, n + 1, dtype=np.uint64))
+        args = (u, inv_idx, row_mask, pk, sig, chk, mask, sc)
+        times = {}
+        for w in dict.fromkeys((0, width)):        # dedupe, keep order
+            name = f"prep_chunk={w}"
+            try:
+                core = bmb.jitted_core(n, k, n, prep_chunk=w)
+                stage2 = core.stages[1]
+                s2_args = (pk, sig, chk, mask, sc, inv_idx)
+                jax.block_until_ready(stage2(*s2_args))  # compile + warm
+                t2 = _timed(lambda: jax.block_until_ready(
+                    stage2(*s2_args)))
+                jax.block_until_ready(core(*args))
+                tt = _timed(lambda: bool(core(*args)))
+                times[w] = tt
+                print(f"  {name:16s}: stage2 {t2:.3f}s, total {tt:.3f}s "
+                      f"-> {n / tt:8.1f} sigs/s")
+            except Exception as e:                 # monolithic may OOM
+                print(f"  {name:16s}: FAILED ({type(e).__name__}: "
+                      f"{str(e)[:80]})")
+        if len(times) == 2:
+            print(f"  chunked speedup: {times[0] / times[width]:.2f}x")
+
+
 def e2e(sizes):
     import jax
 
@@ -180,6 +232,8 @@ def main():
         micro(sizes)
     if mode in ("stages", "all"):
         stages(sizes)
+    if mode == "chunk":
+        chunk(sizes)
     if mode in ("e2e", "all"):
         e2e(sizes)
 

@@ -11,6 +11,12 @@ Usage:
 Per (n_sets, k) shape: stages once, times sharded steady-state execution,
 reports sigs/s and per-device scaling. A poisoned variant runs through
 the same executables to confirm failure isolation under sharding.
+
+LIGHTHOUSE_TPU_LAYOUT selects the engine (round 6): "major" probes the
+batch-major lead-axis sharding, "bm" the batch-minor TRAILING-axis
+sharding (parallel/mesh.minor_sharding); the default "auto" resolves
+per platform (ops/backend._layout) — on a multi-chip accelerator mesh
+that is now the BM engine.
 """
 
 import os
@@ -32,7 +38,9 @@ def main():
     from lighthouse_tpu.parallel import mesh as pm
 
     n_dev = len(jax.devices())
-    print(f"devices: {n_dev} x {jax.devices()[0].platform}", file=sys.stderr)
+    layout = be._layout()
+    print(f"devices: {n_dev} x {jax.devices()[0].platform} "
+          f"(layout {layout})", file=sys.stderr)
     mesh = pm.get_mesh()
     sh = pm.batch_sharding(mesh)
 
@@ -41,12 +49,21 @@ def main():
         sets = ge._example_sets(n_distinct, keys_per_set=k)
         sets = (sets * ((n_sets + n_distinct - 1) // n_distinct))[:n_sets]
         t0 = time.monotonic()
-        args = ge._stage(sets, n_bucket=n_sets, k_bucket=k,
-                         m_floor=n_dev)
-        args = tuple(jax.device_put(a, sh) for a in args)
+        if layout == "bm":
+            from lighthouse_tpu.ops.bm import backend as bmb
+
+            args, m_bucket = be.stage_bm(sets, n_sets, n_sets, k,
+                                         m_floor=n_dev)
+            args = tuple(pm.shard_batch_minor(a, mesh) for a in args)
+            step = bmb.jitted_core(n_sets, k, m_bucket, sharded=True,
+                                   n_devices=n_dev)
+        else:
+            args = ge._stage(sets, n_bucket=n_sets, k_bucket=k,
+                             m_floor=n_dev)
+            args = tuple(jax.device_put(a, sh) for a in args)
+            step = be._jitted_core(n_sets, k, True, n_devices=n_dev)
         stage_s = time.monotonic() - t0
 
-        step = be._jitted_core(n_sets, k, True, n_devices=n_dev)
         t0 = time.monotonic()
         ok = bool(step(*args))
         compile_s = time.monotonic() - t0
@@ -59,15 +76,22 @@ def main():
             iters += 1
         dt = (time.monotonic() - t0) / iters
 
-        # Poison under sharding: same executable must reject.
-        u, inv_idx, pk, sig, chk, mask, sc = args
-        bad = tuple(jax.device_put(a, sh) for a in (
-            u, inv_idx, pk, jnp.asarray(sig).at[1].set(sig[2]), chk, mask,
-            sc))
+        # Poison under sharding: same executable must reject (swap two
+        # signature coordinates; the point leaves the curve/subgroup).
+        if layout == "bm":
+            (u, inv_idx, row_mask, pk, sig, chk, mask, sc) = args
+            sig_bad = jnp.asarray(sig).at[1].set(sig[0])
+            bad = (u, inv_idx, row_mask, pk,
+                   pm.shard_batch_minor(sig_bad, mesh), chk, mask, sc)
+        else:
+            u, inv_idx, pk, sig, chk, mask, sc = args
+            bad = tuple(jax.device_put(a, sh) for a in (
+                u, inv_idx, pk, jnp.asarray(sig).at[1].set(sig[2]), chk,
+                mask, sc))
         assert not bool(step(*bad)), "poison must fail sharded"
 
-        print(f"n={n_sets} k={k} devs={n_dev}: steady {dt:.3f}s "
-              f"-> {n_sets / dt:.1f} sigs/s "
+        print(f"n={n_sets} k={k} devs={n_dev} [{layout}]: "
+              f"steady {dt:.3f}s -> {n_sets / dt:.1f} sigs/s "
               f"({n_sets / dt / n_dev:.1f}/dev; stage {stage_s:.2f}s, "
               f"compile+first {compile_s:.1f}s)")
 

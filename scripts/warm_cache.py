@@ -94,6 +94,82 @@ def main():
     jax.jit(_h2c.map_to_curve_sswu_projective)(u4)[0].block_until_ready()
     print(f"h2c-suite shapes warm ({time.time() - t2b:.0f}s)")
 
+    # Remaining tier-1 bucket shapes: every (n, k[, m]) core a test
+    # compiles that the entry/dryrun warms above don't cover. Each is a
+    # fresh set of persistent-cache entries (cache keys include shapes),
+    # and a cold stage compile is minutes on a 1-core host — warming them
+    # here is what keeps the suite inside its budget on a fresh box.
+    t3 = time.time()
+    import numpy as np
+
+    from lighthouse_tpu.ops import backend as be
+    from lighthouse_tpu.ops import curves as cv
+
+    def warm_major(n_bucket, k_bucket, sharded=False, m_bucket=None):
+        m = m_bucket or n_bucket
+        u = jnp.zeros((m, 2, 2, lb.L), dtype=lb.DTYPE)
+        inv_idx = jnp.asarray(np.arange(n_bucket, dtype=np.int32) % m)
+        pk = jnp.broadcast_to(cv.G1.infinity, (n_bucket, k_bucket, 3, lb.L))
+        sg = jnp.broadcast_to(cv.G2.infinity, (n_bucket, 3, 2, lb.L))
+        chk = jnp.ones((n_bucket,), dtype=bool)
+        mask = jnp.zeros((n_bucket,), dtype=bool)
+        sc = jnp.asarray(np.ones((n_bucket,), dtype=np.uint64))
+        args = (u, inv_idx, pk, sg, chk, mask, sc)
+        if sharded:
+            from lighthouse_tpu.parallel import mesh as pm
+
+            sh = pm.batch_sharding(pm.get_mesh())
+            args = tuple(jax.device_put(a, sh) for a in args)
+        core = be._jitted_core(n_bucket, k_bucket, sharded)
+        jax.block_until_ready(core(*args))
+
+    # test_backend.py unsharded (4, 2); sharded (8, 1) + (16, 4); the
+    # find_invalid_sets bisection halves on the sharded path (8, 4);
+    # beacon-processor warm_one (2, 1); firehose buckets (<=8, k=1).
+    for shape in [(4, 2, False), (2, 1, False), (8, 1, False),
+                  (8, 1, True), (16, 4, True), (8, 4, True),
+                  (4, 4, True)]:
+        warm_major(*shape)
+    print(f"tier-1 major bucket shapes warm ({time.time() - t3:.0f}s)")
+
+    # Batch-minor tier-1 shapes (tests/test_ops_bm.py, test_sharded_bm
+    # .py): the (8, 2, m=8) core, its round-6 chunked-prep twin
+    # (prep_chunk=4), and the sharded BM core at the dryrun shape
+    # (n=16, k=4, m=16 — the m bucket floors at the 8-device mesh).
+    t4 = time.time()
+    from lighthouse_tpu.ops.bm import backend as bmb
+    from lighthouse_tpu.ops.bm import curves as bmc
+    from lighthouse_tpu.ops.bm import limbs as bml
+    from lighthouse_tpu.parallel import mesh as pm
+
+    def warm_bm(n_bucket, k_bucket, m_bucket, prep_chunk=None,
+                sharded=False):
+        u = jnp.zeros((2, 2, bml.L, m_bucket), dtype=bml.DTYPE)
+        inv_idx = jnp.asarray(
+            np.arange(n_bucket, dtype=np.int32) % m_bucket
+        )
+        row_mask = jnp.zeros((m_bucket,), dtype=bool)
+        pk = jnp.broadcast_to(bmc.G1.infinity, (k_bucket, 3, bml.L, n_bucket))
+        sg = jnp.broadcast_to(bmc.G2.infinity, (3, 2, bml.L, n_bucket))
+        chk = jnp.ones((n_bucket,), dtype=bool)
+        mask = jnp.zeros((n_bucket,), dtype=bool)
+        sc = jnp.asarray(np.ones((n_bucket,), dtype=np.uint64))
+        args = (u, inv_idx, row_mask, pk, sg, chk, mask, sc)
+        n_devices = None
+        if sharded:
+            n_devices = jax.device_count()
+            mesh = pm.get_mesh(n_devices)
+            args = tuple(pm.shard_batch_minor(a, mesh) for a in args)
+        core = bmb.jitted_core(n_bucket, k_bucket, m_bucket,
+                               prep_chunk=prep_chunk, sharded=sharded,
+                               n_devices=n_devices)
+        jax.block_until_ready(core(*args))
+
+    warm_bm(8, 2, 8, prep_chunk=0)
+    warm_bm(8, 2, 8, prep_chunk=4)       # round-6 chunked differential
+    warm_bm(16, 4, 16, sharded=True)     # round-6 sharded BM dryrun
+    print(f"tier-1 bm bucket shapes warm ({time.time() - t4:.0f}s)")
+
     # NOTE: the device-KZG graph and the bench shape are deliberately NOT
     # warmed here — their XLA:CPU compiles have repeatedly died in this
     # process (huge-executable serialization segfaults / LLVM mmap

@@ -49,7 +49,8 @@ def pytest_collection_modifyitems(config, items):
     test_ops_pairing around the 50-75% mark; the same compiles succeed
     in young processes — see scripts/warm_cache.py). Stable sort keeps
     relative order within each group."""
-    heavy = ("test_ops_", "test_backend", "test_bisection", "test_kzg")
+    heavy = ("test_ops_", "test_backend", "test_bisection", "test_kzg",
+             "test_sharded_bm", "test_bench_sweep")
     items.sort(
         key=lambda it: 0 if it.fspath.basename.startswith(heavy) else 1
     )

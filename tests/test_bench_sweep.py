@@ -1,0 +1,57 @@
+"""CPU-tier smoke of the bench.py shape sweep (round 6: the sweep grew
+an n-cap + injectable shape list so CI can drive it at toy shapes).
+
+The real sweep times production buckets (minutes of XLA per shape cold);
+this smoke drives the SAME code path at shapes whose cores other suites
+in this process already compile — it catches staging-shape drift between
+bench.py and the engines (the sweep builds its own synthetic tensors),
+not performance.
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import bench  # noqa: E402  (repo-root module)
+
+
+def _check_rows(rows, shapes):
+    assert [(r["n"], r["k"], r["distinct"]) for r in rows] == shapes
+    for r in rows:
+        assert "error" not in r, r
+        assert r["sigs_per_sec"] > 0
+        assert r["secs"] >= 0
+
+
+def test_shape_sweep_major_smoke():
+    from lighthouse_tpu.ops import backend as be
+
+    shapes = [(4, 2, 4), (4, 2, 2)]
+    _check_rows(bench._shape_sweep(be, shapes), shapes)
+
+
+def test_shape_sweep_bm_smoke(monkeypatch):
+    monkeypatch.setenv("LIGHTHOUSE_TPU_LAYOUT", "bm")
+    from lighthouse_tpu.ops import backend as be
+
+    shapes = [(8, 2, 8)]
+    _check_rows(bench._shape_sweep(be, shapes), shapes)
+
+
+def test_default_sweep_caps_n_on_cpu(monkeypatch):
+    """The default shape list drops the 8192 rungs on the CPU tier (a
+    cold 8192 compile is minutes of XLA for a rung CPU never runs),
+    keeps them on accelerators, and honors the explicit override."""
+    monkeypatch.delenv("LIGHTHOUSE_TPU_BENCH_SWEEP_MAX_N", raising=False)
+    cpu = bench._default_sweep_shapes(cpu_only=True)
+    assert max(n for n, _, _ in cpu) == 4096
+    acc = bench._default_sweep_shapes(cpu_only=False)
+    assert (8192, 4, 8192) in acc and (8192, 4, 64) in acc
+    assert cpu == [s for s in acc if s[0] <= 4096]
+
+    monkeypatch.setenv("LIGHTHOUSE_TPU_BENCH_SWEEP_MAX_N", "8192")
+    assert (8192, 4, 64) in bench._default_sweep_shapes(cpu_only=True)
+    monkeypatch.setenv("LIGHTHOUSE_TPU_BENCH_SWEEP_MAX_N", "1024")
+    acc_capped = bench._default_sweep_shapes(cpu_only=False)
+    assert max(n for n, _, _ in acc_capped) == 1024

@@ -4,6 +4,7 @@ engine's production target is the real chip; these pin correctness on
 CPU at every level (limbs -> tower -> curves -> h2c -> pairing -> the
 staged verify backend)."""
 
+import os
 import random
 
 import numpy as np
@@ -157,6 +158,103 @@ def test_pairing_batch_equation():
     m2 = np.ones(n, dtype=bool)
     m2[0] = m2[1] = False
     assert bool(np.asarray(pr.multi_pairing_check(P1b, Q2, jnp.asarray(m2))))
+
+
+def test_bm_chunked_prep_bit_exact(monkeypatch):
+    """Chunked prep (ops/bm/backend._make_prepare with prep_chunk > 0,
+    the round-6 path that unlocks n >= 8192) is BIT-EXACT against the
+    monolithic graph: identical p_proj/s_proj/sets_valid limb bits and
+    identical end-to-end verdicts — including a same-message group that
+    STRADDLES the chunk boundary (the segment combine runs post-restack
+    at full width, so the group must still collapse to one pair) and a
+    poisoned straddler (both cores must reject)."""
+    monkeypatch.setenv("LIGHTHOUSE_TPU_LAYOUT", "bm")
+    from lighthouse_tpu.ops import backend as be
+    from lighthouse_tpu.ops.bm import backend as bmb
+
+    sks = [api.SecretKey(2000 + i) for i in range(4)]
+
+    def make(poison):
+        # Messages 0 1 2 3 3 4: sets 3 and 4 share message 3 ACROSS the
+        # chunk boundary (prep_chunk=4 on an 8-bucket: chunk 0 holds
+        # elements 0-3, chunk 1 holds 4-7).
+        msgs = [bytes([m]) * 32 for m in (0, 1, 2, 3, 3, 4)]
+        sets = []
+        for i, msg in enumerate(msgs):
+            keys = [sks[(i + j) % len(sks)] for j in range(2)]
+            agg = api.AggregateSignature.aggregate(
+                [sk.sign(msg) for sk in keys]
+            )
+            sig = api.Signature.from_bytes(agg.to_bytes())
+            sets.append(api.SignatureSet(
+                signature=sig,
+                signing_keys=[sk.public_key() for sk in keys],
+                message=msg,
+            ))
+        if poison:
+            bad = sets[4]                     # the straddler
+            sets[4] = api.SignatureSet(
+                signature=sets[0].signature,  # a signature over msg 0
+                signing_keys=bad.signing_keys,
+                message=bad.message,
+            )
+        return sets
+
+    scalars = np.arange(3, 3 + 8, dtype=np.uint64)  # deterministic diff
+    for poison in (False, True):
+        args, m_bucket = be.stage_bm(
+            make(poison), 6, 8, 2, scalars=scalars
+        )
+        (u, inv_idx, row_mask, pk, sig, chk, mask, sc) = args
+        outs = []
+        for prep_chunk in (0, 4):
+            core = bmb.jitted_core(8, 2, m_bucket, prep_chunk=prep_chunk)
+            p, s, valid = core.stages[1](pk, sig, chk, mask, sc, inv_idx)
+            outs.append(
+                (np.asarray(p), np.asarray(s), np.asarray(valid))
+            )
+            assert bool(np.asarray(core(*args))) == (not poison)
+        for a, b in zip(outs[0], outs[1]):
+            assert np.array_equal(a, b)
+
+
+def test_bm_prep_chunk_width():
+    """Chunk-width resolution: monolithic at/below the default width,
+    dividing chunks above it, per-device scaling under a mesh, and the
+    env disable."""
+    from lighthouse_tpu.ops.bm.backend import prep_chunk_width
+
+    assert prep_chunk_width(4096) == 0          # peak monolithic bucket
+    assert prep_chunk_width(8192) == 4096
+    assert prep_chunk_width(16384) == 4096
+    assert prep_chunk_width(16384, n_devices=2) == 8192
+    assert prep_chunk_width(8192, n_devices=8) == 0   # 32k > bucket
+    old = os.environ.get("LIGHTHOUSE_TPU_PREP_CHUNK")
+    try:
+        os.environ["LIGHTHOUSE_TPU_PREP_CHUNK"] = "0"
+        assert prep_chunk_width(16384) == 0
+        os.environ["LIGHTHOUSE_TPU_PREP_CHUNK"] = "4"
+        assert prep_chunk_width(8) == 4
+    finally:
+        if old is None:
+            os.environ.pop("LIGHTHOUSE_TPU_PREP_CHUNK", None)
+        else:
+            os.environ["LIGHTHOUSE_TPU_PREP_CHUNK"] = old
+
+
+def test_bm_pairing_product_proj_contract():
+    """Satellite rename: multi_pairing_product_proj returns the raw Fp12
+    product (is_one iff the batch equation holds); the bool wrapper
+    multi_pairing_is_one_proj matches the major engine's contract."""
+    a, b = rng.randrange(1, R), rng.randrange(1, R)
+    ps = [oc.g1_mul(oc.G1_GEN, a), oc.g1_mul(oc.G1_GEN, (-a * b) % R)]
+    qs = [oc.g2_mul(oc.G2_GEN, b), oc.G2_GEN]
+    P1, Q2 = cv.g1_from_affine(ps), cv.g2_from_affine(qs)
+    mask = jnp.ones((2,), dtype=bool)
+    f = pr.multi_pairing_product_proj(P1, Q2, mask)
+    assert bool(np.asarray(tw.fp12_is_one(f))[..., 0])
+    assert bool(np.asarray(pr.multi_pairing_is_one_proj(P1, Q2, mask)))
+    assert pr.multi_pairing_check is pr.multi_pairing_is_one_proj
 
 
 def test_backend_bm_verify(monkeypatch):

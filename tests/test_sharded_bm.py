@@ -1,0 +1,110 @@
+"""Sharded execution of the BATCH-MINOR engine on the virtual 8-device
+CPU mesh (round 6: minor-axis sharding, parallel/mesh.minor_sharding).
+
+Mirror of tests/test_backend.py's sharded tier, but forced through the
+BM layout: the staged tensors carry the batch on the LAST axis, so the
+mesh shards the trailing dim — hash-consed h2c rows and the segment
+combine both run under the mesh. One bucket shape only (compiles are
+cached per shape): 13 real sets in the (n=16, k=4) bucket over 8 devices
+— UNEVEN final shard (the tail device carries padding), MIXED
+keys-per-set, and messages SHARED across the two halves (the hash-cons +
+same-message pair combine must hold under sharding). The poisoned
+variant keeps the message list unchanged so every executable is reused.
+
+Bisection is exercised on the sharded major path (test_backend.py) and
+the unsharded BM path (test_bisection.py); repeating it here would only
+re-pay compiles.
+"""
+
+import pytest
+
+from lighthouse_tpu.crypto.bls.api import (
+    AggregateSignature,
+    SecretKey,
+    Signature,
+    SignatureSet,
+)
+
+
+def _make_sets(n, keys_per_set=2, poison_idx=None):
+    sets = []
+    for i in range(n):
+        sks = [SecretKey(3000 + i * 10 + j) for j in range(keys_per_set)]
+        msg = bytes([i]) * 32
+        agg = AggregateSignature.aggregate([sk.sign(msg) for sk in sks])
+        sig = Signature(point=agg.point, subgroup_checked=True)
+        if poison_idx == i:
+            # Sign the wrong message with the right keys; the staged
+            # message (and so the h2c tensors + m bucket) is unchanged.
+            bad = [sk.sign(b"\xee" * 32) for sk in sks]
+            sig = Signature(
+                point=AggregateSignature.aggregate(bad).point,
+                subgroup_checked=True,
+            )
+        sets.append(
+            SignatureSet(
+                signature=sig,
+                signing_keys=[sk.public_key() for sk in sks],
+                message=msg,
+            )
+        )
+    return sets
+
+
+@pytest.fixture()
+def bm_layout(monkeypatch):
+    monkeypatch.setenv("LIGHTHOUSE_TPU_LAYOUT", "bm")
+    monkeypatch.setenv("LIGHTHOUSE_TPU_CPU_FALLBACK_MAX", "0")
+
+
+def test_auto_layout_selects_bm_on_accelerators(monkeypatch):
+    """Round-6 flip: auto layout selects the BM engine on accelerators
+    UNCONDITIONALLY — sharded meshes no longer fall back to the
+    batch-major engine. CPU keeps major (the suite's warmed XLA:CPU
+    cache lives there)."""
+    from lighthouse_tpu.ops import backend as be
+
+    monkeypatch.delenv("LIGHTHOUSE_TPU_LAYOUT", raising=False)
+    monkeypatch.setattr(be.jax, "default_backend", lambda: "tpu")
+    assert be._layout() == "bm"
+    monkeypatch.setattr(be.jax, "default_backend", lambda: "cpu")
+    assert be._layout() == "major"
+    monkeypatch.setenv("LIGHTHOUSE_TPU_LAYOUT", "bm")
+    assert be._layout() == "bm"
+
+
+def test_sharded_bm_mixed_k_uneven_shard(bm_layout):
+    """13 real sets (7 x k=4 + 6 x k=1, messages 0-6 shared across the
+    halves) in the 16-bucket over 8 devices: valid batch passes, a
+    poisoned mixed set fails — with the minor axis sharded end to end."""
+    from lighthouse_tpu.ops import backend as be
+
+    sets = _make_sets(7, keys_per_set=4) + _make_sets(6, keys_per_set=1)
+    assert be.verify_signature_sets_tpu(sets, sharded=True) is True
+
+    bad = _make_sets(7, keys_per_set=4, poison_idx=3) + \
+        _make_sets(6, keys_per_set=1)
+    assert be.verify_signature_sets_tpu(bad, sharded=True) is False
+
+
+def test_sharded_bm_staging_floors_m_bucket(bm_layout):
+    """The sharded staging floors the distinct-message bucket at the
+    device count (every shard of the minor m axis must be non-empty) and
+    places every staged tensor with minor_sharding."""
+    import jax
+
+    from lighthouse_tpu.ops import backend as be
+    from lighthouse_tpu.parallel import mesh as pm
+
+    n_dev = len(jax.devices())
+    sets = _make_sets(7, keys_per_set=4) + _make_sets(6, keys_per_set=1)
+    args, m_bucket = be.stage_bm(
+        sets, 13, 16, 4, m_floor=n_dev
+    )
+    assert m_bucket % n_dev == 0
+    mesh = pm.get_mesh(n_dev)
+    sharded = [pm.shard_batch_minor(a, mesh) for a in args]
+    for arr in sharded:
+        spec = arr.sharding.spec
+        assert spec[-1] == pm.BATCH_AXIS
+        assert all(s is None for s in spec[:-1])
