@@ -7,6 +7,12 @@ line:
 
     {"metric": ..., "value": N, "unit": "sigs/sec", "vs_baseline": N, ...}
 
+The headline batch repeats 64 distinct messages (gossip firehose shape,
+where same-message pair combining shrinks the pairing stage); the
+`all_distinct_*` fields carry the largest all-distinct sweep row as the
+first-class companion — the throughput a no-hash-consing workload
+(chain-segment replay, op pool) actually gets.
+
 `vs_baseline` divides by a MEASURED same-host baseline: the native C++
 batch verifier (native/src/blscpu.cpp — Montgomery arithmetic, batch-
 inverted Miller loop, same batch equation and h2c), single-threaded on
@@ -90,6 +96,24 @@ def measure_cpu_baseline(sets) -> float:
         return 0.0
 
 
+def _all_distinct_row(sweep) -> dict:
+    """The honest no-hash-consing number: the largest sweep row where every
+    message is distinct (distinct == n) at the headline k. The 64-distinct
+    headline leans on same-message pair combining; chain-segment replay
+    and op-pool batches don't get that break, so this row is the
+    first-class companion metric (VERDICT: don't let the headline imply
+    all workloads hash-cons)."""
+    best = None
+    for row in sweep or []:
+        if row.get("distinct") != row.get("n") or "sigs_per_sec" not in row:
+            continue
+        if row.get("k") != KEYS_PER_SET:
+            continue
+        if best is None or row["n"] > best["n"]:
+            best = row
+    return best or {}
+
+
 def _emit(sigs_per_sec: float, cpu_baseline: float, error: str = "",
           sweep=None) -> None:
     baseline = cpu_baseline if cpu_baseline > 0 else \
@@ -107,6 +131,11 @@ def _emit(sigs_per_sec: float, cpu_baseline: float, error: str = "",
         "keys_per_set": KEYS_PER_SET,
         "distinct_messages": N_DISTINCT,
     }
+    ad = _all_distinct_row(sweep)
+    if ad:
+        out["all_distinct_sigs_per_sec"] = ad["sigs_per_sec"]
+        out["all_distinct_n_sets"] = ad["n"]
+        out["all_distinct_keys_per_set"] = ad["k"]
     if sweep:
         out["sweep"] = sweep
     if error:

@@ -15,6 +15,12 @@ batch policy as each shape becomes safe — the batch former's growth cap
 rises behind it. With a populated persistent cache each step is a cache
 load, so a warm restart reaches full batch size in seconds.
 
+When an AOT warm bundle is active (serving/aot.py, PR 11), each shape
+first tries the verify-bundle fast path — deserialize the exported
+stages and run each once on zeros — and only falls back to the compile
+path on a miss, so a restarted node reaches full batch size in seconds
+even without a populated compilation cache.
+
 The reference has no equivalent (CPU blst needs no compilation); the
 closest analog is its `warn`-level startup preconditioning of caches.
 """
@@ -47,11 +53,20 @@ class ShapeWarmer:
         policy=None,
         shapes: Sequence[Tuple[int, int]] = DEFAULT_SHAPE_GRID,
         sharded: bool = False,
+        bundle: Optional[str] = "auto",
+        layout: Optional[str] = None,
     ):
         self.policy = policy
         self.shapes = tuple(shapes)
         self.sharded = sharded
+        # AOT warm bundle (serving/aot.py): "auto" resolves the process
+        # bundle (LIGHTHOUSE_TPU_WARM_BUNDLE; unset = none), a path opens
+        # that directory, None disables the fast path entirely.
+        self.bundle = bundle
+        self.layout = layout   # None: resolve from the engine selector
         self.warmed: list = []
+        self.bundle_warmed: list = []   # shapes served by bundle verify
+        self.compiled: list = []        # shapes that paid the compile path
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -77,9 +92,53 @@ class ShapeWarmer:
 
     # -------------------------------------------------------------- warming
 
+    def _resolve_bundle(self):
+        """Resolve the AOT bundle object once (None = fast path disabled)."""
+        if self.bundle is None:
+            return None
+        try:
+            from lighthouse_tpu.serving import aot
+        except Exception:
+            return None
+        if self.bundle == "auto":
+            return aot.active_bundle()
+        if isinstance(self.bundle, str):
+            resolved = aot.open_bundle(self.bundle)
+            # Cache the object so later shapes reuse its artifact cache.
+            self.bundle = resolved
+            return resolved
+        return self.bundle  # already a WarmBundle
+
+    def _warm_from_bundle(self, n_bucket: int, k_bucket: int) -> bool:
+        """Verify-bundle fast path: load the shape's exported stages and
+        run each once on zeros — seconds instead of the minutes-per-shape
+        trace+lower cost. False (missing/stale/corrupt) falls back to the
+        compile path, so this can never make warming worse."""
+        bundle = self._resolve_bundle()
+        if bundle is None:
+            return False
+        from lighthouse_tpu.ops import backend as be
+
+        layout = self.layout or be._layout()
+        try:
+            return bundle.warm_core(layout, n_bucket, k_bucket,
+                                    sharded=self.sharded)
+        except Exception:
+            return False
+
     def warm_one(self, n_bucket: int, k_bucket: int) -> None:
-        """Compile + execute one bucket shape on masked synthetic tensors
-        (whichever engine the layout selector routes this process to)."""
+        """Warm one bucket shape: bundle verify fast path first, else
+        compile + execute on masked synthetic tensors (whichever engine
+        the layout selector routes this process to)."""
+        if self._warm_from_bundle(n_bucket, k_bucket):
+            self.bundle_warmed.append((n_bucket, k_bucket))
+            return
+        self.compiled.append((n_bucket, k_bucket))
+        self._warm_compile(n_bucket, k_bucket)
+
+    def _warm_compile(self, n_bucket: int, k_bucket: int) -> None:
+        """The compile path (trace + lower + execute; persistent-cache
+        assisted). Separate from warm_one so tests can stub it."""
         import jax.numpy as jnp
 
         from lighthouse_tpu.ops import backend as be

@@ -41,6 +41,49 @@ class Counter:
                 f"{self.name} {value}\n")
 
 
+class LabeledCounter:
+    """A counter family with ONE label dimension (the lighthouse_metrics
+    `int_counter_vec` analog, single-label: route/reason/outcome style
+    breakdowns). Children are created on first use and exposed as
+    `name{label="value"} n` under one HELP/TYPE header."""
+
+    def __init__(self, name: str, help_text: str, label: str):
+        self.name = name
+        self.help = help_text
+        self.label = label
+        self._values: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    class _Child:
+        def __init__(self, parent: "LabeledCounter", value: str):
+            self._parent = parent
+            self._value = value
+
+        def inc(self, amount: float = 1.0) -> None:
+            with self._parent._lock:
+                self._parent._values[self._value] = \
+                    self._parent._values.get(self._value, 0.0) + amount
+
+        def get(self) -> float:
+            with self._parent._lock:
+                return self._parent._values.get(self._value, 0.0)
+
+    def labels(self, value: str) -> "LabeledCounter._Child":
+        return LabeledCounter._Child(self, str(value))
+
+    def get(self, value: str) -> float:
+        return self.labels(value).get()
+
+    def expose(self) -> str:
+        with self._lock:
+            items = sorted(self._values.items())
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} counter"]
+        for value, count in items:
+            out.append(f'{self.name}{{{self.label}="{value}"}} {count}')
+        return "\n".join(out) + "\n"
+
+
 class Gauge:
     def __init__(self, name: str, help_text: str):
         self.name = name
@@ -137,6 +180,12 @@ class Registry:
 
     def counter(self, name: str, help_text: str = "") -> Counter:
         return self._get_or_make(name, lambda: Counter(name, help_text))
+
+    def counter_vec(self, name: str, help_text: str = "",
+                    label: str = "label") -> LabeledCounter:
+        return self._get_or_make(
+            name, lambda: LabeledCounter(name, help_text, label)
+        )
 
     def gauge(self, name: str, help_text: str = "") -> Gauge:
         return self._get_or_make(name, lambda: Gauge(name, help_text))

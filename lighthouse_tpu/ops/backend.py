@@ -59,6 +59,21 @@ def _next_pow2(n: int, floor: int = 1) -> int:
     return b
 
 
+def _warm_dispatch(stage_id: str, fallback):
+    """Route a stage through the AOT warm bundle when one is active
+    (serving/aot.py): a restarted process serves bundle-covered shapes
+    from deserialized exports instead of re-tracing the ~60k-op graphs.
+    No bundle (the default) = one None check per call, then `fallback`.
+    Guarded: the serving layer is optional and must never break the
+    engine."""
+    try:
+        from lighthouse_tpu.serving import aot
+
+        return aot.stage_dispatch("major", stage_id, fallback)
+    except Exception:
+        return fallback
+
+
 # ---------------------------------------------------------------------------
 # Jitted core (cached per bucket shape)
 # ---------------------------------------------------------------------------
@@ -148,9 +163,9 @@ def _jitted_core(n_bucket: int, k_bucket: int, sharded: bool,
     `n_devices` bounds the sharded mesh (default: all devices)."""
     del n_bucket, k_bucket  # cache key only; shapes live in the arguments
     if not sharded:
-        stage1 = jax.jit(_h2g2_gather)
-        stage2 = jax.jit(_prepare_pairs)
-        stage3 = jax.jit(_pairing_check)
+        stage1 = _warm_dispatch("h2g2", jax.jit(_h2g2_gather))
+        stage2 = _warm_dispatch("prepare", jax.jit(_prepare_pairs))
+        stage3 = _warm_dispatch("pairing", jax.jit(_pairing_check))
 
         def core(u, inv_idx, pk_proj, sig_proj, sig_checked, set_mask,
                  scalars):

@@ -240,6 +240,18 @@ def _prepare_jit(m_bucket: int, prep_chunk: int):
     return jax.jit(_make_prepare(m_bucket, prep_chunk))
 
 
+def _warm_dispatch(stage_id: str, fallback):
+    """Route a stage through the AOT warm bundle when one is active (see
+    ops/backend._warm_dispatch; the BM prep stage id carries its chunk
+    width because the scan structure isn't visible in the avals)."""
+    try:
+        from lighthouse_tpu.serving import aot
+
+        return aot.stage_dispatch("bm", stage_id, fallback)
+    except Exception:
+        return fallback
+
+
 def jitted_core(n_bucket: int, k_bucket: int, m_bucket: int,
                 prep_chunk: Optional[int] = None, sharded: bool = False,
                 n_devices: Optional[int] = None):
@@ -266,9 +278,10 @@ def _jitted_core(n_bucket: int, k_bucket: int, m_bucket: int,
                  n_devices: Optional[int]):
     del n_bucket, k_bucket  # cache keys; shapes live in the arguments
     if not sharded:
-        stage1 = _stage1_jit
-        stage2 = _prepare_jit(m_bucket, prep_chunk)
-        stage3 = _stage3_jit
+        stage1 = _warm_dispatch("h2g2", _stage1_jit)
+        stage2 = _warm_dispatch(f"prepare:c{prep_chunk}",
+                                _prepare_jit(m_bucket, prep_chunk))
+        stage3 = _warm_dispatch("pairing", _stage3_jit)
     else:
         from lighthouse_tpu.parallel import mesh as pm
 

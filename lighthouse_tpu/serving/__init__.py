@@ -1,0 +1,50 @@
+"""Restart-proof serving: AOT warm bundles, deadline-aware continuous
+batching, and a cost-model CPU/device router (ROADMAP Open item 3).
+
+Three cooperating layers:
+
+  * `aot`       — `jax.export`-serialized pipeline stages in a versioned
+                  on-disk bundle; a fresh process verifies the bundle
+                  (load + one masked execution per shape) instead of
+                  re-tracing, so restart-to-first-full-batch is seconds.
+  * `scheduler` — the batch former evolved into continuous batching:
+                  accumulate against the slot clock, close on
+                  bucket-full or deadline, mixed work types sharing one
+                  device pipeline.
+  * `router`    — a measured-latency table routing small or
+                  deadline-critical batches to the native CPU backend
+                  while bulk traffic rides the device engine.
+
+Submodules import lazily (PEP 562): `ops.backend` consults `aot` from
+inside its jit builders, and an eager package import would cycle.
+"""
+
+_SUBMODULES = ("aot", "router", "scheduler")
+
+__all__ = [
+    "aot", "router", "scheduler",
+    "ContinuousBatchScheduler", "VerifyJob",
+    "CostModelRouter", "LatencyTable",
+    "WarmBundle", "make_bundle", "open_bundle",
+]
+
+_EXPORTS = {
+    "ContinuousBatchScheduler": ("scheduler", "ContinuousBatchScheduler"),
+    "VerifyJob": ("scheduler", "VerifyJob"),
+    "CostModelRouter": ("router", "CostModelRouter"),
+    "LatencyTable": ("router", "LatencyTable"),
+    "WarmBundle": ("aot", "WarmBundle"),
+    "make_bundle": ("aot", "make_bundle"),
+    "open_bundle": ("aot", "open_bundle"),
+}
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in _SUBMODULES:
+        return importlib.import_module(f".{name}", __name__)
+    if name in _EXPORTS:
+        mod, attr = _EXPORTS[name]
+        return getattr(importlib.import_module(f".{mod}", __name__), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

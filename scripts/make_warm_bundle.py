@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Produce an AOT warm bundle (serving/aot.py) for a production shape grid.
+
+The bundle front-loads the dominant restart cost — tracing + lowering
+each bucket shape's three pipeline stages (minutes per shape, even small
+ones) — into serialized `jax.export` artifacts a fresh process loads in
+seconds. Run on the SAME platform + jax version the consumer will run
+(the manifest pins both; mismatches fall back to the compile path):
+
+    JAX_PLATFORMS=cpu python scripts/make_warm_bundle.py \
+        --out /var/lib/lighthouse-tpu/warm_bundle --shapes 64x1,256x4
+
+Then point the node at it:
+
+    LIGHTHOUSE_TPU_WARM_BUNDLE=/var/lib/lighthouse-tpu/warm_bundle ...
+
+Re-running over an existing bundle is incremental: shapes already in the
+manifest are kept, only new ones export. Each export is a heavy XLA job —
+never run two producers (or a producer and anything else compiling)
+concurrently on a small host.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_shapes(text: str):
+    """'64x1,256x4' -> [(64, 1), (256, 4)]."""
+    shapes = []
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        n, _, k = part.partition("x")
+        shapes.append((int(n), int(k or "1")))
+    return shapes
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", required=True, help="bundle directory")
+    ap.add_argument("--shapes", default="64x1,64x4,256x4",
+                    help="comma-separated NxK grid (default: %(default)s; "
+                    "the full warmer grid takes hours — grow incrementally)")
+    ap.add_argument("--layout", default=None, choices=["major", "bm"],
+                    help="engine layout (default: whatever this platform "
+                    "selects — major on CPU, bm on accelerators)")
+    ap.add_argument("--sharded", action="store_true",
+                    help="key entries for the sharded core variant")
+    ap.add_argument("--no-verify", action="store_true",
+                    help="skip the post-export verify pass")
+    args = ap.parse_args(argv)
+
+    from lighthouse_tpu.serving import aot
+
+    shapes = parse_shapes(args.shapes)
+    print(f"exporting {len(shapes)} shape(s) -> {args.out}")
+    t0 = time.time()
+    report = aot.make_bundle(args.out, shapes, layout=args.layout,
+                             sharded=args.sharded, progress=print)
+    dt = time.time() - t0
+    print(f"bundle: {report.cores} core(s), "
+          f"{report.stages_exported} stage(s) exported "
+          f"({report.stages_reused} reused), "
+          f"{report.bytes_written / 1e6:.1f} MB written, "
+          f"export {report.export_secs:.0f}s of {dt:.0f}s total")
+    for err in report.errors:
+        print(f"  ERROR {err}")
+
+    if not args.no_verify:
+        bundle = aot.open_bundle(args.out)
+        if bundle is None:
+            print("verify: bundle did not open (stale/corrupt manifest)")
+            return 1
+        ok_n, bad_n = bundle.verify()
+        if bad_n == 0:
+            print(f"verify: all {ok_n} artifact(s) load hash-clean")
+        else:
+            print(f"verify: {bad_n} bad artifact(s) (of {ok_n + bad_n})")
+            return 1
+    return 1 if report.errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -39,6 +39,22 @@ def test_shape_sweep_bm_smoke(monkeypatch):
     _check_rows(bench._shape_sweep(be, shapes), shapes)
 
 
+def test_all_distinct_row_selection():
+    """The first-class all-distinct metric picks the LARGEST sweep row
+    with distinct == n at the headline k, skipping errored rows."""
+    sweep = [
+        {"n": 2048, "k": 4, "distinct": 64, "sigs_per_sec": 14000.0},
+        {"n": 1024, "k": 4, "distinct": 1024, "sigs_per_sec": 3100.0},
+        {"n": 4096, "k": 4, "distinct": 4096, "sigs_per_sec": 3600.0},
+        {"n": 1024, "k": 1, "distinct": 1024, "sigs_per_sec": 9999.0},
+        {"n": 8192, "k": 4, "distinct": 8192, "error": "OOM"},
+    ]
+    row = bench._all_distinct_row(sweep)
+    assert (row["n"], row["sigs_per_sec"]) == (4096, 3600.0)
+    assert bench._all_distinct_row(None) == {}
+    assert bench._all_distinct_row([]) == {}
+
+
 def test_default_sweep_caps_n_on_cpu(monkeypatch):
     """The default shape list drops the 8192 rungs on the CPU tier (a
     cold 8192 compile is minutes of XLA for a rung CPU never runs),

@@ -1,0 +1,395 @@
+"""Serving subsystem tier-1 tests (serving/: aot + router + scheduler).
+
+The bundle machinery (manifest, content hashes, staleness/corruption
+fallback, warm_core, stage dispatch) is exercised through a synthetic
+"toy" layout: exporting the REAL pipeline stages traces for minutes even
+at n=4 (the very cost the bundle exists to front-load), so tier-1 runs
+them only through scripts (make_warm_bundle.py, probe_restart.py). The
+toy stages export in well under a second and flow through every code
+path the real ones do.
+"""
+
+import json
+import os
+
+import pytest
+
+from lighthouse_tpu.serving import aot
+
+# ---------------------------------------------------------------------------
+# Toy layout
+# ---------------------------------------------------------------------------
+
+
+def _toy_stage1(x):
+    return x * 2.0
+
+
+def _toy_stage2(x, y):
+    return (x + y).sum()
+
+
+def _toy_stages(n, k, m):
+    import jax
+    import jax.numpy as jnp
+
+    S = jax.ShapeDtypeStruct
+    return [
+        ("s1", _toy_stage1, (S((n,), jnp.float32),)),
+        ("s2", _toy_stage2, (S((n,), jnp.float32), S((n,), jnp.float32))),
+    ]
+
+
+aot.register_layout(aot.LayoutSpec("toy", _toy_stages, lambda n: [1]))
+
+TOY_SHAPES = ((4, 1), (64, 1), (256, 1))
+
+
+@pytest.fixture(scope="module")
+def toy_bundle_dir(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("warm_bundle"))
+    report = aot.make_bundle(path, TOY_SHAPES, layout="toy")
+    assert not report.errors
+    assert report.cores == len(TOY_SHAPES)
+    return path
+
+
+@pytest.fixture(autouse=True)
+def _clean_active_bundle():
+    aot.reset_stats()
+    yield
+    aot.reset_active_bundle()
+
+
+# ---------------------------------------------------------------------------
+# Bundle: roundtrip, dispatch, staleness, corruption
+# ---------------------------------------------------------------------------
+
+
+def test_bundle_roundtrip_and_warm_core(toy_bundle_dir):
+    bundle = aot.open_bundle(toy_bundle_dir)
+    assert bundle is not None
+    ok, bad = bundle.verify()
+    assert bad == 0 and ok == 2 * len(TOY_SHAPES)
+    for n, k in TOY_SHAPES:
+        assert bundle.has_core("toy", n, k, m_bucket=1)
+        assert bundle.warm_core("toy", n, k)
+    assert aot.stats().hits > 0
+    assert aot.stats().corrupt == 0
+
+
+def test_stage_dispatch_serves_matching_avals(toy_bundle_dir):
+    import jax.numpy as jnp
+    import numpy as np
+
+    aot.set_active_bundle(toy_bundle_dir)
+    fallback_calls = []
+
+    def fallback(x):
+        fallback_calls.append(x.shape)
+        return x * 2.0
+
+    fn = aot.stage_dispatch("toy", "s1", fallback)
+    hits0 = aot.stats().hits
+    out = fn(jnp.asarray(np.arange(4, dtype=np.float32)))
+    np.testing.assert_allclose(np.asarray(out), [0.0, 2.0, 4.0, 6.0])
+    assert not fallback_calls            # served from the bundle
+    assert aot.stats().hits > hits0
+    # A shape the bundle doesn't hold falls through to the fallback.
+    fn(jnp.zeros((5,), jnp.float32))
+    assert fallback_calls == [(5,)]
+
+
+def test_no_active_bundle_uses_fallback():
+    import jax.numpy as jnp
+
+    aot.set_active_bundle(None)
+    calls = []
+    fn = aot.stage_dispatch("toy", "s1", lambda x: calls.append(1) or x)
+    fn(jnp.zeros((4,), jnp.float32))
+    assert calls == [1]
+
+
+def test_env_var_resolution(toy_bundle_dir, monkeypatch):
+    monkeypatch.setenv(aot.ENV_VAR, toy_bundle_dir)
+    aot.reset_active_bundle()
+    assert aot.active_bundle() is not None
+    monkeypatch.setenv(aot.ENV_VAR, "/nonexistent/bundle/dir")
+    aot.reset_active_bundle()
+    assert aot.active_bundle() is None
+
+
+def test_stale_bundle_rejected(toy_bundle_dir, tmp_path):
+    import shutil
+
+    stale = str(tmp_path / "stale")
+    shutil.copytree(toy_bundle_dir, stale)
+    mpath = os.path.join(stale, aot.MANIFEST_NAME)
+    manifest = json.loads(open(mpath).read())
+    manifest["bundle_version"] = aot.BUNDLE_VERSION + 1
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+    assert aot.open_bundle(stale) is None
+
+    manifest["bundle_version"] = aot.BUNDLE_VERSION
+    manifest["jax_version"] = "0.0.0-not-this"
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+    assert aot.open_bundle(stale) is None
+    assert aot.stats().stale >= 2
+
+
+def _corrupt_all_artifacts(path):
+    for name in os.listdir(path):
+        if name.endswith(".bin"):
+            with open(os.path.join(path, name), "r+b") as f:
+                f.seek(0)
+                f.write(b"\xff" * 16)
+
+
+def test_corrupt_artifact_fails_closed(toy_bundle_dir, tmp_path):
+    import shutil
+
+    bad_dir = str(tmp_path / "corrupt")
+    shutil.copytree(toy_bundle_dir, bad_dir)
+    _corrupt_all_artifacts(bad_dir)
+    bundle = aot.open_bundle(bad_dir)   # manifest is intact: opens fine
+    assert bundle is not None
+    assert not bundle.warm_core("toy", 4, 1)
+    assert aot.stats().corrupt > 0
+    ok, bad = bundle.verify()
+    assert ok == 0 and bad == 2 * len(TOY_SHAPES)
+
+
+# ---------------------------------------------------------------------------
+# ShapeWarmer fast path + AdaptiveBatchPolicy growth across kill/restart
+# ---------------------------------------------------------------------------
+
+
+def _make_warmer(policy, bundle_dir):
+    from lighthouse_tpu.beacon_processor.warming import ShapeWarmer
+
+    return ShapeWarmer(policy, shapes=TOY_SHAPES, bundle=bundle_dir,
+                       layout="toy")
+
+
+def test_policy_growth_across_restart_without_recompiling(toy_bundle_dir):
+    """Satellite 4: a killed-and-restarted node re-warms every shape from
+    the bundle — the policy's growth cap reaches max batch size with the
+    compile path never taken, in BOTH 'processes'."""
+    from lighthouse_tpu.beacon_processor.processor import AdaptiveBatchPolicy
+
+    for _restart in range(2):   # process 1, then the post-kill process
+        policy = AdaptiveBatchPolicy(max_bucket=256, warm=(2,))
+        assert policy.batch_limit(256) == 4      # cold cap: one growth step
+        warmer = _make_warmer(policy, toy_bundle_dir)
+        warmer._run()                            # synchronous (no thread)
+        assert warmer.bundle_warmed == list(TOY_SHAPES)
+        assert warmer.compiled == []
+        assert policy.batch_limit(256) == 256    # full size, zero compiles
+
+
+def test_corrupted_bundle_falls_back_to_compile_path(toy_bundle_dir,
+                                                     tmp_path):
+    import shutil
+
+    from lighthouse_tpu.beacon_processor.processor import AdaptiveBatchPolicy
+
+    bad_dir = str(tmp_path / "corrupt")
+    shutil.copytree(toy_bundle_dir, bad_dir)
+    _corrupt_all_artifacts(bad_dir)
+
+    policy = AdaptiveBatchPolicy(max_bucket=256, warm=(2,))
+    warmer = _make_warmer(policy, bad_dir)
+    compile_calls = []
+    warmer._warm_compile = lambda n, k: compile_calls.append((n, k))
+    warmer._run()
+    assert warmer.bundle_warmed == []
+    assert warmer.compiled == list(TOY_SHAPES)   # clean fallback, no crash
+    assert compile_calls == list(TOY_SHAPES)
+    assert policy.batch_limit(256) == 256        # compile path still warms
+
+
+def test_warmer_defaults_need_no_bundle():
+    """No bundle configured/active: the fast path declines instantly and
+    the compile path runs (stubbed here — tier-1 never pays real XLA)."""
+    from lighthouse_tpu.beacon_processor.warming import ShapeWarmer
+
+    warmer = ShapeWarmer(shapes=((2, 1),))
+    warmer._warm_compile = lambda n, k: None
+    warmer.warm_one(2, 1)
+    assert warmer.compiled == [(2, 1)]
+    assert warmer.bundle_warmed == []
+
+
+# ---------------------------------------------------------------------------
+# Router
+# ---------------------------------------------------------------------------
+
+
+def _fresh_registry():
+    from lighthouse_tpu.common.metrics import Registry
+
+    return Registry()
+
+
+def test_latency_table_predict():
+    from lighthouse_tpu.serving.router import LatencyTable
+
+    t = LatencyTable()
+    assert t.predict("device", 64) is None
+    t.seed("device", 64, 0.5)
+    t.seed("cpu", 64, 0.064)
+    assert t.predict("device", 64) == 0.5
+    # Device predictions carry over as-is (compile-amortized, sublinear);
+    # cpu scales linearly with the size ratio.
+    assert t.predict("device", 256) == 0.5
+    assert t.predict("cpu", 128) == pytest.approx(0.128)
+    # seed never overrides; observe EWMAs toward the measurement.
+    t.seed("device", 64, 99.0)
+    assert t.predict("device", 64) == 0.5
+    t.observe("device", 64, 1.0)
+    assert 0.5 < t.predict("device", 64) < 1.0
+
+
+def test_router_decision_rules():
+    from lighthouse_tpu.serving.router import CostModelRouter, LatencyTable
+
+    t = LatencyTable()
+    r = CostModelRouter(table=t, small_batch_max=4,
+                        registry=_fresh_registry())
+    assert r.route(3) == ("cpu", "small")
+    assert r.route(64) == ("device", "default")      # no data yet
+    t.seed("device", 64, 2.0)
+    t.seed("cpu", 64, 0.5)
+    # Deadline rule: device prediction blows the budget, cpu fits.
+    assert r.route(64, deadline_budget=1.0) == ("cpu", "deadline")
+    # Cost rule: plenty of budget, cheaper route wins.
+    assert r.route(64, deadline_budget=10.0) == ("cpu", "cost")
+    t.observe("cpu", 64, 99.0)                       # cpu now expensive
+    assert r.route(64, deadline_budget=10.0)[0] == "device"
+
+
+def test_router_verify_via_registered_backend():
+    from lighthouse_tpu.crypto.bls import api
+    from lighthouse_tpu.serving.router import CostModelRouter, LatencyTable
+
+    api.register_backend("_test_rt_cpu", lambda sets: all(
+        s != "bad" for s in sets))
+    reg = _fresh_registry()
+    r = CostModelRouter(table=LatencyTable(), cpu_backend="_test_rt_cpu",
+                        small_batch_max=16, registry=reg)
+    ok, route = r.verify(["a", "b", "c"])
+    assert ok and route == "cpu"
+    ok, route = r.verify(["a", "bad"])
+    assert not ok
+    assert r.find_invalid(["a", "bad", "c"], "cpu") == [1]
+    assert reg.counter_vec("serving_router_route_total").get("cpu") == 2
+    assert reg.counter_vec("serving_router_reason_total").get("small") == 2
+    # Measured latencies landed in the table for future predictions.
+    assert r.table.predict("cpu", 4) is not None
+
+
+# ---------------------------------------------------------------------------
+# Scheduler + the full dry run (satellite 6)
+# ---------------------------------------------------------------------------
+
+
+def _mk_sched(clock, policy=None, router=None, **kw):
+    from lighthouse_tpu.serving.scheduler import ContinuousBatchScheduler
+
+    return ContinuousBatchScheduler(clock, policy=policy, router=router,
+                                    registry=_fresh_registry(), **kw)
+
+
+def test_scheduler_deadline_close():
+    """A lone job dispatches when the predicted latency no longer fits
+    the remaining slot-third budget — never earlier."""
+    from lighthouse_tpu.common.slot_clock import ManualSlotClock
+    from lighthouse_tpu.crypto.bls import api
+    from lighthouse_tpu.serving.router import CostModelRouter, LatencyTable
+    from lighthouse_tpu.serving.scheduler import VerifyJob
+
+    api.register_backend("_test_dl", lambda sets: True)
+    t = LatencyTable()
+    t.seed("cpu", 1, 0.5)
+    router = CostModelRouter(table=t, cpu_backend="_test_dl",
+                             small_batch_max=16,
+                             registry=_fresh_registry())
+    clock = ManualSlotClock(genesis_time=0, seconds_per_slot=12)
+    clock.set_slot(10)                       # budget: full 4s third
+    sched = _mk_sched(clock, router=router, close_margin_s=0.05)
+    sched.submit(VerifyJob("gossip_attestation", "x"))
+    assert not sched.step()                  # 3.5s headroom: accumulate
+    clock.advance_seconds(3.3)               # 0.7s left, 0.5s predicted
+    assert not sched.step()
+    clock.advance_seconds(0.25)              # 0.45s left: would miss
+    assert sched.step()
+    assert sched.stats.batches == 1
+    assert sched.depth() == 0
+
+
+def test_serve_dry_run(toy_bundle_dir):
+    """Satellite 6 smoke: bundle verify + warmer + scheduler + router
+    drain a mixed attestation/sync-committee workload deterministically,
+    with one poisoned set isolated and per-route/deadline metrics live."""
+    from lighthouse_tpu.beacon_processor.processor import AdaptiveBatchPolicy
+    from lighthouse_tpu.common.slot_clock import ManualSlotClock
+    from lighthouse_tpu.crypto.bls import api
+    from lighthouse_tpu.serving.router import CostModelRouter, LatencyTable
+    from lighthouse_tpu.serving.scheduler import VerifyJob
+
+    # 1. Warm bundle verifies and feeds the policy without compiling.
+    bundle = aot.set_active_bundle(toy_bundle_dir)
+    assert bundle is not None
+    ok, bad = bundle.verify()
+    assert bad == 0
+    policy = AdaptiveBatchPolicy(max_bucket=256, warm=(2,))
+    warmer = _make_warmer(policy, toy_bundle_dir)
+    warmer._run()
+    assert warmer.compiled == []
+
+    # 2. Mixed workload through scheduler + router on fake backends
+    #    (tier-1 determinism: no XLA, no host signing).
+    api.register_backend("_test_srv_dev", lambda sets: all(
+        getattr(s, "bad", False) is False for s in sets))
+    api.register_backend("_test_srv_cpu", lambda sets: all(
+        getattr(s, "bad", False) is False for s in sets))
+    table = LatencyTable()
+    table.seed("device", 16, 0.001)
+    table.seed("cpu", 16, 0.100)
+    router = CostModelRouter(table=table, cpu_backend="_test_srv_cpu",
+                             device_backend="_test_srv_dev",
+                             small_batch_max=2, registry=_fresh_registry())
+    clock = ManualSlotClock(genesis_time=0, seconds_per_slot=12)
+    clock.set_slot(7)
+    sched = _mk_sched(clock, policy=policy, router=router)
+
+    class SSet:
+        def __init__(self, bad=False):
+            self.bad = bad
+
+    results = {}
+    kinds = ("gossip_attestation", "gossip_sync_signature")
+    poisoned_idx = 5
+    for i in range(21):
+        job = VerifyJob(kinds[i % 2], SSet(bad=(i == poisoned_idx)),
+                        on_result=lambda ok, i=i: results.setdefault(i, ok))
+        assert sched.submit(job)
+
+    # Continuous close: depth 21 >= the 16 bucket -> dispatch NOW, no
+    # flush needed; the tail drains on run_until_idle.
+    assert sched.step()
+    assert sched.stats.batches == 1
+    sched.run_until_idle()
+
+    assert sched.depth() == 0
+    assert len(results) == 21
+    assert [i for i, ok in results.items() if not ok] == [poisoned_idx]
+    assert sched.stats.poisoned == 1
+    assert sched.stats.batches == 3          # 16 + 4 + 1
+    assert sched.stats.deadline_hits == 3    # fake backends: instant
+    assert sched.stats.deadline_misses == 0
+    assert sched.stats.by_route == {"device": 2, "cpu": 1}
+    # The device batches taught the policy those bucket shapes ran.
+    assert 16 in policy.warm
