@@ -149,12 +149,28 @@ def verify_unaggregated_attestation(
     )
 
 
+def _report_poisoned_origin(chain, origins, i) -> None:
+    """Bisection named a culprit: route it back to the networking layer's
+    peer penalties instead of silently dropping (the reference's
+    `BeaconChainError -> PeerAction` mapping). `chain.peer_reporter` is
+    installed by NetworkService; standalone chains have none."""
+    reporter = getattr(chain, "peer_reporter", None)
+    if reporter is None or origins is None:
+        return
+    origin = origins[i]
+    if origin is not None:
+        reporter(origin, "InvalidSignature")
+
+
 def batch_verify_unaggregated_attestations(
-    chain, attestations: Sequence[Tuple[object, Optional[int]]]
+    chain, attestations: Sequence[Tuple[object, Optional[int]]],
+    origins: Optional[Sequence[Optional[str]]] = None,
 ) -> List[object]:
     """One BLS backend call for the whole batch (batch.rs:140); per-item
     fallback isolates poison. Returns results aligned with the inputs:
-    VerifiedUnaggregatedAttestation or AttestationError."""
+    VerifiedUnaggregatedAttestation or AttestationError. `origins` (when
+    given, aligned with the inputs) names the gossip peer each item came
+    from so a poisoned signature is charged to its sender."""
     results: List[object] = [None] * len(attestations)
     staged = []  # (idx, IndexedUnaggregated, indexed_att, sig_set)
     for i, (att, subnet_id) in enumerate(attestations):
@@ -173,6 +189,7 @@ def batch_verify_unaggregated_attestations(
         for pos, (i, ind, iatt, _) in enumerate(staged):
             if pos in bad:
                 results[i] = AttestationError("InvalidSignature")
+                _report_poisoned_origin(chain, origins, i)
             else:
                 results[i] = VerifiedUnaggregatedAttestation(
                     attestation=attestations[i][0],
@@ -262,10 +279,12 @@ def verify_aggregated_attestation(chain, signed_aggregate) -> VerifiedAggregated
 
 
 def batch_verify_aggregated_attestations(
-    chain, signed_aggregates: Sequence[object]
+    chain, signed_aggregates: Sequence[object],
+    origins: Optional[Sequence[Optional[str]]] = None,
 ) -> List[object]:
     """3 sets per aggregate, one backend call (batch.rs:31); fallback as
-    above. Results align with inputs."""
+    above. Results align with inputs; `origins` as in the unaggregated
+    batch — poisoned aggregates are charged to their gossip sender."""
     results: List[object] = [None] * len(signed_aggregates)
     staged = []
     for i, agg in enumerate(signed_aggregates):
@@ -289,6 +308,7 @@ def batch_verify_aggregated_attestations(
         for pos, (i, ind, _) in enumerate(staged):
             if pos in bad_items:
                 results[i] = AttestationError("InvalidSignature")
+                _report_poisoned_origin(chain, origins, i)
             else:
                 results[i] = VerifiedAggregatedAttestation(
                     signed_aggregate=signed_aggregates[i],

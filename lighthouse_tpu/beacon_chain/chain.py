@@ -101,6 +101,10 @@ class BeaconChain:
         # Head-change hook (events.rs SSE head stream analog on the network
         # side): NetworkService sets it to publish light-client updates.
         self.on_head_change = None
+        # Poisoned-batch culprit hook: batch bisection calls
+        # peer_reporter(peer_id, reason) when an invalid signature is
+        # attributed to a gossip origin. NetworkService installs it.
+        self.peer_reporter = None
         self._lock = threading.RLock()      # import lock (module docstring)
         self._fc_lock = threading.RLock()   # fork-choice lock
 
@@ -416,9 +420,9 @@ class BeaconChain:
             self.op_pool.insert_attestation(attestation, verified.indexed_attestation)
         return verified
 
-    def process_attestation_batch(self, attestations):
+    def process_attestation_batch(self, attestations, origins=None):
         results = att_ver.batch_verify_unaggregated_attestations(
-            self, [(a, None) for a in attestations]
+            self, [(a, None) for a in attestations], origins=origins
         )
         for r in results:
             if isinstance(r, att_ver.VerifiedUnaggregatedAttestation):

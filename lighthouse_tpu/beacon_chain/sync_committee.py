@@ -91,11 +91,14 @@ def verify_sync_committee_message(
 
 
 def batch_verify_sync_committee_messages(
-    chain, messages: List[object]
+    chain, messages: List[object],
+    origins: Optional[List[Optional[str]]] = None,
 ) -> List[object]:
     """ONE backend call for a batch of gossip sync messages, per-item
     fallback on poison (the sync analog of attestation batch.rs). Results
-    align with inputs: VerifiedSyncCommitteeMessage or SyncCommitteeError."""
+    align with inputs: VerifiedSyncCommitteeMessage or SyncCommitteeError.
+    `origins` (aligned, optional) charges poisoned signatures to the
+    gossip peer that relayed them via `chain.peer_reporter`."""
     results: List[object] = [None] * len(messages)
     staged = []
     state = chain.head_state_for_signatures()
@@ -136,6 +139,10 @@ def batch_verify_sync_committee_messages(
         for pos, (i, positions, _sset) in enumerate(staged):
             if pos in bad:
                 results[i] = SyncCommitteeError("InvalidSignature")
+                reporter = getattr(chain, "peer_reporter", None)
+                if reporter is not None and origins is not None \
+                        and origins[i] is not None:
+                    reporter(origins[i], "InvalidSignature")
             else:
                 # Observe only what verified (see the single-item path).
                 chain.observed_sync_contributors.observe(

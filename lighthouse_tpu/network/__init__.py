@@ -3,6 +3,8 @@
 from .gossip import ACCEPT, IGNORE, REJECT, GossipNode, SimTransport
 from .peer_manager import PeerAction, PeerManager
 from .rpc import RpcError, RpcHandler
+from .scoring import PeerScore, PeerScoreParams, TopicScoreParams, \
+    eth2_score_params
 from .service import NetworkService
 from .sync import SyncManager, SyncState
 from .types import Protocol, Status
@@ -14,6 +16,8 @@ __all__ = [
     "NetworkService",
     "PeerAction",
     "PeerManager",
+    "PeerScore",
+    "PeerScoreParams",
     "Protocol",
     "REJECT",
     "RpcError",
@@ -22,4 +26,6 @@ __all__ = [
     "Status",
     "SyncManager",
     "SyncState",
+    "TopicScoreParams",
+    "eth2_score_params",
 ]

@@ -31,19 +31,25 @@ import threading
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Set
 
+from lighthouse_tpu.common import metrics as _metrics
+
 from . import pubsub_pb
 from .peer_manager import PeerAction, PeerManager
+from .scoring import PeerScore, PeerScoreParams
 
 D_LO, D, D_HI = 6, 8, 12
 SEEN_CACHE_SIZE = 16384
 MCACHE_SIZE = 1024         # cached full messages (IWANT serving)
 GOSSIP_LAZY = 6            # IHAVE targets per heartbeat (D_lazy)
 PRUNE_BACKOFF_SECS = 60    # gossipsub v1.1 prune backoff we advertise
+PRUNE_BACKOFF_HEARTBEATS = 8   # ...enforced in heartbeat ticks (~1s each)
 MAX_IHAVE_IDS = 64         # ids honored per IHAVE control frame
 MAX_IWANT_PENDING = 4096   # outstanding gossip-promise cap
 MAX_IWANT_SERVE = 64       # messages served per inbound IWANT frame
 MAX_IWANT_RETRANSMITS = 3  # serves per (peer, mid) — gossipsub v1.1 cap
 MAX_IWANT_SERVED_TRACK = 8192  # LRU bound on the (peer, mid) serve counts
+IWANT_FLOOD_THRESHOLD = 256    # IWANT ids per peer per heartbeat before P7
+PROMISE_TTL_HEARTBEATS = 2     # IWANT promise lifetime before P7
 
 ACCEPT = "accept"
 IGNORE = "ignore"
@@ -108,6 +114,8 @@ class GossipNode:
         transport,
         peer_manager: Optional[PeerManager] = None,
         rng: Optional[random.Random] = None,
+        score_params: Optional[PeerScoreParams] = None,
+        registry: Optional[_metrics.Registry] = None,
     ):
         self.peer_id = peer_id
         self.transport = transport
@@ -125,9 +133,28 @@ class GossipNode:
         self._seen: "OrderedDict[bytes, bool]" = OrderedDict()
         # mcache: mid -> (topic, wire_data) for IWANT serving (mcache.rs).
         self._mcache: "OrderedDict[bytes, tuple]" = OrderedDict()
-        self._iwant_pending: Set[bytes] = set()
+        # Gossip promises (gossip_promises.rs): every IWANT we send records
+        # which peer advertised the id and a heartbeat deadline; an
+        # unfulfilled promise is a P7 behaviour penalty.
+        self._promises: Dict[bytes, tuple] = {}   # mid -> (peer, deadline)
         # (peer, mid) -> times served in response to IWANT (LRU-bounded).
         self._iwant_served: "OrderedDict[tuple, int]" = OrderedDict()
+        # IWANT ids requested per peer this heartbeat (flood accounting).
+        self._iwant_counts: Dict[str, int] = {}
+        # (topic, peer) -> heartbeat tick the PRUNE backoff expires; one
+        # map for both directions (we pruned them / they pruned us).
+        self._backoff: Dict[tuple, int] = {}
+        # v1.1 peer scoring. P5 feeds from the PeerManager's RAW RealScore
+        # (not the gossip-combined effective score: that would loop the
+        # gossip score back into itself).
+        self.scoring = PeerScore(
+            score_params, app_score_fn=self.peer_manager.real_score
+        )
+        reg = registry or _metrics.REGISTRY
+        self._events = reg.counter_vec(
+            "gossip_peer_score_events_total",
+            "Peer-scoring events (evictions, rejected GRAFTs, broken "
+            "promises, floods, graylisted RPCs, score bans)", "event")
         self._lock = threading.RLock()
         if hasattr(transport, "register"):
             transport.register(self)
@@ -139,6 +166,10 @@ class GossipNode:
             if not self.peer_manager.peer_connected(peer_id):
                 return
             self.peers.add(peer_id)
+            # Socket transports that know the remote address feed P6
+            # (IP colocation); the sim fabric has no addresses.
+            ip = getattr(self.transport, "peer_ip", lambda _p: None)(peer_id)
+            self.scoring.add_peer(peer_id, ip=ip)
             if self.subscriptions:
                 self._send_rpc(peer_id, {"subscriptions": [
                     (True, t) for t in self.subscriptions
@@ -148,6 +179,7 @@ class GossipNode:
         with self._lock:
             self.peers.discard(peer_id)
             self.peer_manager.peer_disconnected(peer_id)
+            self.scoring.remove_peer(peer_id)
             for ps in self.peer_topics.values():
                 ps.discard(peer_id)
             for m in self.mesh.values():
@@ -173,8 +205,8 @@ class GossipNode:
         with self._lock:
             self.subscriptions.discard(topic)
             for p in self.mesh.pop(topic, set()):
-                self._send_rpc(p, {"control": {
-                    "prune": [(topic, PRUNE_BACKOFF_SECS)]}})
+                self.scoring.prune(p, topic)
+                self._send_prune(p, topic)
             for p in self.peers:
                 self._send_rpc(p, {"subscriptions": [(False, topic)]})
 
@@ -200,6 +232,13 @@ class GossipNode:
                     self.rng.shuffle(candidates)
                     fan.update(candidates[:D])
                 targets = set(fan)
+            # publish_threshold (v1.1): self-published messages are not
+            # flooded to peers we no longer trust to propagate them.
+            targets = {
+                p for p in targets
+                if (self.scoring.score(p)
+                    > self.scoring.params.publish_threshold)
+            }
             for p in targets:
                 self._send_rpc(p, {"publish": [
                     {"topic": topic, "data": data}]})
@@ -218,6 +257,13 @@ class GossipNode:
         with self._lock:
             if self.peer_manager.is_banned(src):
                 return
+            # Graylist (v1.1): below the graylist threshold the peer's
+            # entire RPC stream is ignored — cheaper than validating
+            # anything a proven-hostile peer sends.
+            if (self.scoring.score(src)
+                    <= self.scoring.params.graylist_threshold):
+                self._events.labels("graylisted").inc()
+                return
             for subscribe, topic in rpc["subscriptions"]:
                 if subscribe:
                     self.peer_topics.setdefault(topic, set()).add(src)
@@ -228,33 +274,65 @@ class GossipNode:
                     self.mesh.get(topic, set()).discard(src)
             control = rpc["control"] or {}
             for topic in control.get("graft", []):
-                if topic in self.subscriptions:
-                    self.mesh.setdefault(topic, set()).add(src)
-                else:
-                    self._send_rpc(src, {"control": {
-                        "prune": [(topic, PRUNE_BACKOFF_SECS)]}})
+                self._handle_graft(src, topic)
             for topic, _backoff in control.get("prune", []):
+                # Respect the sender's backoff: no re-GRAFT from our side
+                # until it expires (we keep the tick-domain window).
+                if src in self.mesh.get(topic, set()):
+                    self.scoring.prune(src, topic)
                 self.mesh.get(topic, set()).discard(src)
+                self._record_backoff(topic, src)
             self._handle_ihave_iwant(src, control)
             for msg in rpc["publish"]:
                 self._handle_gossip(src, msg)
 
+    def _handle_graft(self, src: str, topic: str) -> None:
+        """Score-gated GRAFT acceptance (gossipsub v1.1 §graft handling):
+        a GRAFT inside the PRUNE backoff we advertised is a protocol
+        violation (P7 behaviour penalty + re-PRUNE); a negative-score
+        peer is refused without penalty; everything else joins the mesh."""
+        if topic not in self.subscriptions:
+            self._send_rpc(src, {"control": {
+                "prune": [(topic, PRUNE_BACKOFF_SECS)]}})
+            return
+        if self._in_backoff(topic, src):
+            self.scoring.add_penalty(src)
+            self._events.labels("graft_rejected_backoff").inc()
+            self._send_prune(src, topic)     # refreshes the backoff window
+            return
+        if self.scoring.score(src) < 0:
+            self._events.labels("graft_rejected_score").inc()
+            self._send_prune(src, topic)
+            return
+        if src not in self.mesh.setdefault(topic, set()):
+            self.mesh[topic].add(src)
+            self.scoring.graft(src, topic)
+
     def _handle_ihave_iwant(self, src: str, control: dict) -> None:
+        # Below the gossip threshold no IHAVE/IWANT is exchanged at all
+        # (v1.1: lazy gossip is a privilege, not a right).
+        if (self.scoring.score(src)
+                < self.scoring.params.gossip_threshold):
+            return
         # IHAVE: request unseen ids (gossip_promises.rs tracks these).
         # Bounded against IHAVE floods: only subscribed topics count, at
         # most MAX_IHAVE_IDS ids per control frame, and the outstanding-
         # promise set is capped (real gossipsub's max_ihave_length +
-        # gossip-promise expiry play the same role).
+        # gossip-promise expiry play the same role). Every IWANT we send
+        # records a PROMISE against the advertiser: if the message never
+        # arrives, the advertiser eats a P7 behaviour penalty (IHAVE spam
+        # without delivery — promise breaking).
         want: List[bytes] = []
+        deadline = self.scoring.tick + PROMISE_TTL_HEARTBEATS
         for topic, mids in control.get("ihave", []):
             if topic not in self.subscriptions:
                 continue
             for mid in mids[:MAX_IHAVE_IDS]:
                 if len(want) >= MAX_IHAVE_IDS or \
-                        len(self._iwant_pending) >= MAX_IWANT_PENDING:
+                        len(self._promises) >= MAX_IWANT_PENDING:
                     break
-                if mid not in self._seen and mid not in self._iwant_pending:
-                    self._iwant_pending.add(mid)
+                if mid not in self._seen and mid not in self._promises:
+                    self._promises[mid] = (src, deadline)
                     want.append(mid)
         if want:
             self._send_rpc(src, {"control": {"iwant": [want]}})
@@ -267,6 +345,13 @@ class GossipNode:
         serve = []
         for mids in control.get("iwant", []):
             for mid in mids:
+                # Flood accounting: every REQUESTED id counts (served or
+                # not); crossing the per-heartbeat threshold is one P7.
+                n = self._iwant_counts.get(src, 0) + 1
+                self._iwant_counts[src] = n
+                if n == IWANT_FLOOD_THRESHOLD:
+                    self.scoring.add_penalty(src)
+                    self._events.labels("iwant_flood").inc()
                 if len(serve) >= MAX_IWANT_SERVE:
                     break
                 key = (src, mid)
@@ -302,8 +387,10 @@ class GossipNode:
             self.peer_manager.report_peer(src, PeerAction.LOW_TOLERANCE)
             return
         mid = _id_from_body(topic, body, MESSAGE_DOMAIN_VALID_SNAPPY)
-        self._iwant_pending.discard(mid)
+        self._promises.pop(mid, None)     # promise fulfilled (any sender)
         if mid in self._seen:
+            # A duplicate still proves this mesh link forwards (P3).
+            self.scoring.duplicate_message(src, topic)
             return
         self._mark_seen(mid)
         if topic not in self.subscriptions:
@@ -316,10 +403,12 @@ class GossipNode:
             except Exception:
                 verdict = REJECT
         if verdict == REJECT:
+            self.scoring.reject_message(src, topic)          # P4
             self.peer_manager.report_peer(src, PeerAction.LOW_TOLERANCE)
             return
         if verdict == IGNORE:
             return
+        self.scoring.deliver_message(src, topic)             # P2 (+P3)
         self._mcache_put(mid, topic, data)
         handler = self.handlers.get(topic)
         if handler is not None:
@@ -334,13 +423,42 @@ class GossipNode:
 
     def heartbeat(self) -> None:
         with self._lock:
+            self.scoring.refresh_scores()
+            self._expire_promises()
+            self._iwant_counts.clear()
+            tick = self.scoring.tick
+            # Keep expired entries one extra tick so the outbound-graft
+            # slack (see _in_backoff) still sees them on the expiry tick.
+            self._backoff = {
+                k: v for k, v in self._backoff.items() if v + 1 > tick}
+            # Score → PeerManager action flow: the gossip score is blended
+            # into the peer's effective score; crossing the manager's
+            # disconnect/ban thresholds drops the connection here.
+            for p in list(self.peers):
+                action = self.peer_manager.update_gossip_score(
+                    p, self.scoring.score(p))
+                if action is not None:
+                    self._events.labels(f"score_{action}").inc()
+                    self.peer_disconnected(p)
             for topic in list(self.subscriptions):
                 self._maintain_mesh(topic)
                 self._emit_gossip(topic)
-            # Gossip promises expire each heartbeat: an advertised message
-            # that never arrived frees its slot (and may be re-requested).
-            self._iwant_pending.clear()
             self.peer_manager.heartbeat()
+
+    def _expire_promises(self) -> None:
+        """Unfulfilled IWANT promises (gossip_promises.rs): the advertiser
+        broke its word — ONE P7 penalty per peer per heartbeat regardless
+        of how many ids it spammed (go-gossipsub semantics; per-id
+        penalties would make the quadratic P7 explosive)."""
+        tick = self.scoring.tick
+        broken: Set[str] = set()
+        for mid, (peer, deadline) in list(self._promises.items()):
+            if tick > deadline:
+                del self._promises[mid]
+                broken.add(peer)
+        for peer in broken:
+            self.scoring.add_penalty(peer)
+            self._events.labels("broken_promise").inc()
 
     def _emit_gossip(self, topic: str) -> None:
         """Lazy gossip (the 'gossip' in gossipsub): advertise recent
@@ -354,6 +472,8 @@ class GossipNode:
             p for p in self.peer_topics.get(topic, set())
             if p in self.peers and p not in mesh
             and not self.peer_manager.is_banned(p)
+            and (self.scoring.score(p)
+                 >= self.scoring.params.gossip_threshold)
         ]
         self.rng.shuffle(candidates)
         for p in candidates[:GOSSIP_LAZY]:
@@ -363,25 +483,75 @@ class GossipNode:
     def _maintain_mesh(self, topic: str) -> None:
         mesh = self.mesh.setdefault(topic, set())
         mesh &= self.peers
+        # Scored eviction (v1.1): negative-score mesh members are pruned
+        # every heartbeat — this is what breaks an eclipse once the Sybils'
+        # withholding/flooding drives their scores negative.
+        for p in [p for p in mesh if self.scoring.score(p) < 0]:
+            self._prune_peer(topic, p)
+            self._events.labels("mesh_eviction").inc()
         available = {
             p for p in self.peer_topics.get(topic, set())
             if p in self.peers and not self.peer_manager.is_banned(p)
+            and self.scoring.score(p) >= 0
+            and not self._in_backoff(topic, p, slack=1)
         }
         if len(mesh) < D_LO:
             candidates = list(available - mesh)
             self.rng.shuffle(candidates)
             for p in candidates[: D - len(mesh)]:
                 mesh.add(p)
+                self.scoring.graft(p, topic)
                 self._send_rpc(p, {"control": {"graft": [topic]}})
         elif len(mesh) > D_HI:
-            excess = list(mesh)
-            self.rng.shuffle(excess)
-            for p in excess[: len(mesh) - D]:
-                mesh.discard(p)
-                self._send_rpc(p, {"control": {
-                    "prune": [(topic, PRUNE_BACKOFF_SECS)]}})
+            # Keep the best-scored members; prune excess from the bottom.
+            ranked = sorted(mesh, key=self.scoring.score)
+            for p in ranked[: len(mesh) - D]:
+                self._prune_peer(topic, p)
+        # Opportunistic grafting: when the MEDIAN mesh score sags (the
+        # mesh is dominated by barely-positive peers — the eclipse's
+        # steady state), graft extra above-median candidates so honest
+        # peers displace the squatters.
+        if len(mesh) >= D_LO:
+            scores = sorted(self.scoring.score(p) for p in mesh)
+            median = scores[len(scores) // 2]
+            if median < self.scoring.params.opportunistic_graft_threshold:
+                cands = [p for p in available - mesh
+                         if self.scoring.score(p) > median]
+                self.rng.shuffle(cands)
+                og = self.scoring.params.opportunistic_graft_peers
+                for p in cands[:og]:
+                    mesh.add(p)
+                    self.scoring.graft(p, topic)
+                    self._send_rpc(p, {"control": {"graft": [topic]}})
+                    self._events.labels("opportunistic_graft").inc()
 
     # ------------------------------------------------------------------ util
+
+    def _prune_peer(self, topic: str, peer: str) -> None:
+        """Remove from the mesh, book P3b, send PRUNE + record backoff."""
+        self.mesh.get(topic, set()).discard(peer)
+        self.scoring.prune(peer, topic)
+        self._send_prune(peer, topic)
+
+    def _send_prune(self, dst: str, topic: str) -> None:
+        self._record_backoff(topic, dst)
+        self._send_rpc(dst, {"control": {
+            "prune": [(topic, PRUNE_BACKOFF_SECS)]}})
+
+    def _record_backoff(self, topic: str, peer: str) -> None:
+        self._backoff[(topic, peer)] = (
+            self.scoring.tick + PRUNE_BACKOFF_HEARTBEATS)
+
+    def _in_backoff(self, topic: str, peer: str, slack: int = 0) -> bool:
+        """`slack` > 0 is the gossipsub backoff-slack idea: our heartbeat
+        clock and the pruner's are offset by up to one tick, so grafting
+        the instant OUR window expires can still land inside THEIRS and
+        eat an unfair P7. Outbound grafting waits the extra tick; the
+        inbound GRAFT check stays exact."""
+        expiry = self._backoff.get((topic, peer))
+        if expiry is None:
+            return False
+        return self.scoring.tick < expiry + slack
 
     def _mark_seen(self, mid: bytes) -> None:
         self._seen[mid] = True

@@ -33,11 +33,18 @@ _ACTION_DELTA = {
     PeerAction.HIGH_TOLERANCE: -1.0,
 }
 
+# Weight of the gossipsub score in the effective score (score.rs
+# GOSSIPSUB_GREYLIST_THRESHOLD mapping): only NEGATIVE gossip scores count
+# (good gossip behaviour must not offset RPC misbehaviour), scaled so the
+# gossipsub graylist threshold (-80) lands exactly on BAN_THRESHOLD (-50).
+GOSSIP_SCORE_WEIGHT = 0.625
+
 
 @dataclass
 class PeerInfo:
     peer_id: str
     score: float = 0.0
+    gossip_score: float = 0.0        # latest gossipsub v1.1 score
     last_update: float = field(default_factory=time.monotonic)
     connected: bool = True
     banned: bool = False
@@ -100,12 +107,51 @@ class PeerManager:
             return None
 
     def score(self, peer_id: str) -> float:
+        """EFFECTIVE score: decayed RealScore blended with the (negative
+        part of the) gossipsub score — what the ban/disconnect state
+        machine acts on (score.rs Score::score)."""
+        with self._lock:
+            info = self.peers.get(peer_id)
+            if info is None:
+                return 0.0
+            self._decay(info)
+            return info.score + GOSSIP_SCORE_WEIGHT * min(
+                0.0, info.gossip_score)
+
+    def real_score(self, peer_id: str) -> float:
+        """RAW decayed RealScore, gossip-free. This is what feeds gossipsub
+        P5 (app-specific): feeding the effective score back would loop the
+        gossip score into itself."""
         with self._lock:
             info = self.peers.get(peer_id)
             if info is None:
                 return 0.0
             self._decay(info)
             return info.score
+
+    def update_gossip_score(self, peer_id: str,
+                            gossip_score: float) -> Optional[str]:
+        """Record the latest gossipsub score; returns "ban"/"disconnect"
+        when the blended effective score crosses a threshold (the
+        reference's update_gossipsub_scores heartbeat path)."""
+        with self._lock:
+            info = self.peers.setdefault(peer_id, PeerInfo(peer_id))
+            info.gossip_score = gossip_score
+            self._decay(info)
+            effective = info.score + GOSSIP_SCORE_WEIGHT * min(
+                0.0, gossip_score)
+            if effective <= BAN_THRESHOLD:
+                if not info.banned:
+                    info.banned = True
+                    info.connected = False
+                    return "ban"
+                return None
+            if effective <= DISCONNECT_THRESHOLD:
+                if info.connected:
+                    info.connected = False
+                    return "disconnect"
+                return None
+            return None
 
     def is_banned(self, peer_id: str) -> bool:
         with self._lock:

@@ -1,0 +1,391 @@
+"""Gossipsub v1.1 peer scoring — the P1-P7 topic + global score function.
+
+Mirror of the reference client's vendored gossipsub fork (peer_score.rs /
+params.rs, PAPER.md L8): each peer accumulates per-topic counters —
+P1 time-in-mesh, P2 first-message deliveries, P3 mesh-delivery deficit,
+P3b sticky mesh-failure penalty, P4 invalid messages — plus three global
+components: P5 application-specific (fed from the PeerManager's RealScore),
+P6 IP-colocation, and P7 behaviour penalty (PRUNE-backoff violations,
+broken gossip promises, IWANT floods). The combined score gates GRAFT
+acceptance, mesh retention, lazy-gossip emission and (below the graylist
+threshold) the peer's entire RPC stream.
+
+    score(p) = cap( Σ_topic w_t · (w1·P1 + w2·P2 + w3·P3 + w3b·P3b + w4·P4) )
+             + w5·P5 + w6·P6 + w7·P7
+
+Deliberate deviation from the reference: the engine is HEARTBEAT-clocked,
+not wall-clocked. Every decay interval, mesh-time quantum, activation
+window and backoff is counted in heartbeats (`refresh_scores` ticks the
+clock), because the simulator and the multi-process testnet drive
+heartbeats manually — wall-clock scoring would be non-deterministic under
+test and dead time would score peers while the world is paused. One
+heartbeat ≈ 1 s of mainnet time for parameter intuition.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Tuple
+
+
+@dataclass
+class TopicScoreParams:
+    """Per-topic weights/decays (params.rs TopicScoreParams)."""
+
+    topic_weight: float = 1.0
+    # P1: time in mesh (positive, capped — small so longevity never masks
+    # misbehaviour penalties).
+    time_in_mesh_weight: float = 0.05
+    time_in_mesh_cap: float = 60.0           # heartbeats
+    # P2: first message deliveries (positive, decaying counter).
+    first_message_deliveries_weight: float = 1.0
+    first_message_deliveries_decay: float = 0.8
+    first_message_deliveries_cap: float = 10.0
+    # P3: mesh message delivery deficit (negative; squared). Applies only
+    # after `activation` heartbeats in the mesh so a fresh graft is not
+    # punished before it had a chance to deliver anything.
+    mesh_message_deliveries_weight: float = -2.0
+    mesh_message_deliveries_decay: float = 0.8
+    mesh_message_deliveries_threshold: float = 1.0
+    mesh_message_deliveries_cap: float = 10.0
+    mesh_message_deliveries_activation: int = 3   # heartbeats in mesh
+    # P3b: sticky failure penalty, booked from the deficit at PRUNE time.
+    mesh_failure_penalty_weight: float = -3.0
+    mesh_failure_penalty_decay: float = 0.9
+    # P4: invalid messages (negative; squared).
+    invalid_message_deliveries_weight: float = -10.0
+    invalid_message_deliveries_decay: float = 0.9
+
+
+@dataclass
+class PeerScoreParams:
+    """Global weights + thresholds (params.rs PeerScoreParams and the
+    PeerScoreThresholds the router consults)."""
+
+    topics: Dict[str, TopicScoreParams] = field(default_factory=dict)
+    default_topic: TopicScoreParams = field(default_factory=TopicScoreParams)
+    topic_score_cap: float = 20.0
+    # P5: application-specific (the PeerManager RealScore, in [-100, 100]).
+    app_specific_weight: float = 0.2
+    # P6: IP colocation — (peers_on_ip - threshold)^2 above the threshold.
+    # Threshold 3 tolerates small NAT groups; a Sybil swarm does not pass.
+    ip_colocation_factor_weight: float = -5.0
+    ip_colocation_factor_threshold: int = 3
+    # P7: behaviour penalty (squared above the threshold).
+    behaviour_penalty_weight: float = -5.0
+    behaviour_penalty_decay: float = 0.9
+    behaviour_penalty_threshold: float = 0.0
+    decay_to_zero: float = 0.01
+    # Thresholds (negative, increasingly severe).
+    gossip_threshold: float = -10.0     # no IHAVE/IWANT exchange below
+    publish_threshold: float = -50.0    # no self-published messages below
+    graylist_threshold: float = -80.0   # all RPC ignored below
+    # Opportunistic grafting: when the MEDIAN mesh score sags below this,
+    # graft up to `opportunistic_graft_peers` above-median candidates.
+    opportunistic_graft_threshold: float = 0.2
+    opportunistic_graft_peers: int = 2
+
+    def topic_params(self, topic: str) -> TopicScoreParams:
+        return self.topics.get(topic, self.default_topic)
+
+
+# The synthetic topic P4 penalties land under when the invalid signature
+# is only attributed AFTER gossip validation (poisoned-batch bisection in
+# the beacon processor names a culprit peer but no longer knows the topic).
+APP_TOPIC = "_app"
+
+
+@dataclass
+class _TopicStats:
+    in_mesh: bool = False
+    graft_tick: int = 0                  # heartbeat the peer joined the mesh
+    mesh_time: float = 0.0               # heartbeats in mesh (P1)
+    first_message_deliveries: float = 0.0
+    mesh_message_deliveries: float = 0.0
+    mesh_failure_penalty: float = 0.0
+    invalid_message_deliveries: float = 0.0
+
+
+@dataclass
+class _PeerStats:
+    topics: Dict[str, _TopicStats] = field(default_factory=dict)
+    behaviour_penalty: float = 0.0
+    ip: Optional[str] = None
+    connected: bool = True
+
+
+class PeerScore:
+    """The scoring state machine. All mutators are O(1); `score` is
+    O(active topics). Thread-safe (the gossip node calls under its own
+    lock, but the peer reporter may come from a processor thread)."""
+
+    def __init__(self, params: Optional[PeerScoreParams] = None,
+                 app_score_fn: Optional[Callable[[str], float]] = None):
+        self.params = params or PeerScoreParams()
+        self.app_score_fn = app_score_fn
+        self.tick = 0
+        self._peers: Dict[str, _PeerStats] = {}
+        self._ip_counts: Dict[str, int] = {}
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------ membership
+
+    def add_peer(self, peer: str, ip: Optional[str] = None) -> None:
+        with self._lock:
+            st = self._peers.setdefault(peer, _PeerStats())
+            st.connected = True
+            if ip is not None:
+                self.set_ip(peer, ip)
+
+    def set_ip(self, peer: str, ip: str) -> None:
+        with self._lock:
+            st = self._peers.setdefault(peer, _PeerStats())
+            if st.ip == ip:
+                return
+            if st.ip is not None:
+                self._ip_counts[st.ip] = max(0, self._ip_counts[st.ip] - 1)
+            st.ip = ip
+            self._ip_counts[ip] = self._ip_counts.get(ip, 0) + 1
+
+    def remove_peer(self, peer: str) -> None:
+        """Disconnect: positive state is forgotten, negative state is
+        RETAINED (score.rs retain_score — reconnecting must not launder a
+        bad score)."""
+        with self._lock:
+            st = self._peers.get(peer)
+            if st is None:
+                return
+            if self.score(peer) >= 0:
+                if st.ip is not None:
+                    self._ip_counts[st.ip] = max(
+                        0, self._ip_counts[st.ip] - 1)
+                del self._peers[peer]
+            else:
+                st.connected = False
+                for ts in st.topics.values():
+                    ts.in_mesh = False
+
+    # ------------------------------------------------------------------ mesh
+
+    def graft(self, peer: str, topic: str) -> None:
+        with self._lock:
+            ts = self._topic(peer, topic)
+            ts.in_mesh = True
+            ts.graft_tick = self.tick
+            ts.mesh_message_deliveries = 0.0
+
+    def prune(self, peer: str, topic: str) -> None:
+        """Leave the mesh; an under-delivering peer books the P3b sticky
+        penalty from its deficit (peer_score.rs prune path)."""
+        with self._lock:
+            ts = self._topic(peer, topic)
+            if ts.in_mesh:
+                d = self._deficit(ts, self.params.topic_params(topic))
+                if d > 0:
+                    ts.mesh_failure_penalty += d * d
+            ts.in_mesh = False
+
+    # ------------------------------------------------------------ deliveries
+
+    def deliver_message(self, peer: str, topic: str) -> None:
+        """First delivery of a message (P2 + P3 when the peer is in our
+        mesh for the topic)."""
+        with self._lock:
+            p = self.params.topic_params(topic)
+            ts = self._topic(peer, topic)
+            ts.first_message_deliveries = min(
+                p.first_message_deliveries_cap,
+                ts.first_message_deliveries + 1.0,
+            )
+            if ts.in_mesh:
+                ts.mesh_message_deliveries = min(
+                    p.mesh_message_deliveries_cap,
+                    ts.mesh_message_deliveries + 1.0,
+                )
+
+    def duplicate_message(self, peer: str, topic: str) -> None:
+        """A duplicate still proves the mesh link works (near-first
+        window collapsed to: every duplicate counts toward P3)."""
+        with self._lock:
+            p = self.params.topic_params(topic)
+            ts = self._topic(peer, topic)
+            if ts.in_mesh:
+                ts.mesh_message_deliveries = min(
+                    p.mesh_message_deliveries_cap,
+                    ts.mesh_message_deliveries + 1.0,
+                )
+
+    def reject_message(self, peer: str, topic: str) -> None:
+        """Validation REJECT (P4)."""
+        with self._lock:
+            self._topic(peer, topic).invalid_message_deliveries += 1.0
+
+    def reject_app_message(self, peer: str) -> None:
+        """P4 attributed after the fact (poisoned-batch bisection)."""
+        self.reject_message(peer, APP_TOPIC)
+
+    def add_penalty(self, peer: str, n: float = 1.0) -> None:
+        """P7: backoff violation, broken promise, IWANT flood, ..."""
+        with self._lock:
+            self._peers.setdefault(peer, _PeerStats()).behaviour_penalty += n
+
+    # ----------------------------------------------------------------- score
+
+    def score(self, peer: str) -> float:
+        with self._lock:
+            st = self._peers.get(peer)
+            if st is None:
+                return 0.0
+            P = self.params
+            topic_sum = 0.0
+            for topic, ts in st.topics.items():
+                p = P.topic_params(topic)
+                s = 0.0
+                if ts.in_mesh:
+                    s += p.time_in_mesh_weight * min(
+                        ts.mesh_time, p.time_in_mesh_cap)
+                s += (p.first_message_deliveries_weight
+                      * ts.first_message_deliveries)
+                d = self._deficit(ts, p)
+                if d > 0:
+                    s += p.mesh_message_deliveries_weight * d * d
+                s += p.mesh_failure_penalty_weight * ts.mesh_failure_penalty
+                s += (p.invalid_message_deliveries_weight
+                      * ts.invalid_message_deliveries ** 2)
+                topic_sum += p.topic_weight * s
+            total = min(topic_sum, P.topic_score_cap)
+            if self.app_score_fn is not None:
+                total += P.app_specific_weight * self.app_score_fn(peer)
+            if st.ip is not None:
+                surplus = (self._ip_counts.get(st.ip, 0)
+                           - P.ip_colocation_factor_threshold)
+                if surplus > 0:
+                    total += P.ip_colocation_factor_weight * surplus ** 2
+            excess = st.behaviour_penalty - P.behaviour_penalty_threshold
+            if excess > 0:
+                total += P.behaviour_penalty_weight * excess ** 2
+            return total
+
+    def breakdown(self, peer: str) -> Dict[str, float]:
+        """Per-component P1-P7 contributions (metrics/probe visibility)."""
+        with self._lock:
+            st = self._peers.get(peer)
+            out = {f"p{k}": 0.0 for k in (1, 2, 3, 4, 5, 6, 7)}
+            out["p3b"] = 0.0
+            if st is None:
+                out["score"] = 0.0
+                return out
+            P = self.params
+            for topic, ts in st.topics.items():
+                p = P.topic_params(topic)
+                w = p.topic_weight
+                if ts.in_mesh:
+                    out["p1"] += w * p.time_in_mesh_weight * min(
+                        ts.mesh_time, p.time_in_mesh_cap)
+                out["p2"] += w * (p.first_message_deliveries_weight
+                                  * ts.first_message_deliveries)
+                d = self._deficit(ts, p)
+                if d > 0:
+                    out["p3"] += w * p.mesh_message_deliveries_weight * d * d
+                out["p3b"] += (w * p.mesh_failure_penalty_weight
+                               * ts.mesh_failure_penalty)
+                out["p4"] += (w * p.invalid_message_deliveries_weight
+                              * ts.invalid_message_deliveries ** 2)
+            if self.app_score_fn is not None:
+                out["p5"] = P.app_specific_weight * self.app_score_fn(peer)
+            if st.ip is not None:
+                surplus = (self._ip_counts.get(st.ip, 0)
+                           - P.ip_colocation_factor_threshold)
+                if surplus > 0:
+                    out["p6"] = P.ip_colocation_factor_weight * surplus ** 2
+            excess = st.behaviour_penalty - P.behaviour_penalty_threshold
+            if excess > 0:
+                out["p7"] = P.behaviour_penalty_weight * excess ** 2
+            out["score"] = self.score(peer)
+            return out
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {p: self.breakdown(p) for p in self._peers}
+
+    # ------------------------------------------------------------- heartbeat
+
+    def refresh_scores(self) -> None:
+        """One heartbeat: advance the clock, accrue mesh time, decay every
+        decaying counter (peer_score.rs refresh_scores)."""
+        with self._lock:
+            self.tick += 1
+            P = self.params
+            zero = P.decay_to_zero
+            dead = []
+            for peer, st in self._peers.items():
+                for topic, ts in st.topics.items():
+                    p = P.topic_params(topic)
+                    if ts.in_mesh:
+                        ts.mesh_time = self.tick - ts.graft_tick
+                    ts.first_message_deliveries *= \
+                        p.first_message_deliveries_decay
+                    ts.mesh_message_deliveries *= \
+                        p.mesh_message_deliveries_decay
+                    ts.mesh_failure_penalty *= p.mesh_failure_penalty_decay
+                    ts.invalid_message_deliveries *= \
+                        p.invalid_message_deliveries_decay
+                    for attr in ("first_message_deliveries",
+                                 "mesh_message_deliveries",
+                                 "mesh_failure_penalty",
+                                 "invalid_message_deliveries"):
+                        if getattr(ts, attr) < zero:
+                            setattr(ts, attr, 0.0)
+                st.behaviour_penalty *= P.behaviour_penalty_decay
+                if st.behaviour_penalty < zero:
+                    st.behaviour_penalty = 0.0
+                if not st.connected and self.score(peer) >= 0:
+                    dead.append(peer)
+            for peer in dead:     # retained negative state decayed to par
+                st = self._peers.pop(peer)
+                if st.ip is not None:
+                    self._ip_counts[st.ip] = max(
+                        0, self._ip_counts[st.ip] - 1)
+
+    # ------------------------------------------------------------------ util
+
+    def _topic(self, peer: str, topic: str) -> _TopicStats:
+        return self._peers.setdefault(
+            peer, _PeerStats()).topics.setdefault(topic, _TopicStats())
+
+    def _deficit(self, ts: _TopicStats, p: TopicScoreParams) -> float:
+        """P3 deficit: active mesh members delivering below threshold."""
+        if not ts.in_mesh:
+            return 0.0
+        if self.tick - ts.graft_tick < p.mesh_message_deliveries_activation:
+            return 0.0
+        return max(
+            0.0, p.mesh_message_deliveries_threshold
+            - ts.mesh_message_deliveries)
+
+
+def eth2_score_params(topics: Tuple[str, ...] = ()) -> PeerScoreParams:
+    """The CLIENT profile (NetworkService). The reference derives each
+    topic's mesh-delivery (P3/P3b) threshold from its expected message
+    rate (score parameter decoupling in the gossipsub scoring paper);
+    uncalibrated P3 punishes honest peers for TOPIC silence — an eth2
+    node subscribes to quiet topics (attester_slashing, light-client
+    updates) where nobody delivers anything for epochs at a time. Until
+    per-topic rate calibration exists, the client profile runs with
+    P3/P3b DISABLED and leans on P2/P4/P5/P6/P7, which is how the
+    adversarial testnet drives Sybils out (floods, broken promises,
+    backoff violations, invalid messages are all rate-independent). The
+    bare `PeerScoreParams()` defaults keep P3 hot for sim worlds and
+    probes whose topics have known traffic. The aggregate table lives in
+    NOTES_GOSSIP_SCORING.md."""
+
+    def _quiet_safe() -> TopicScoreParams:
+        return TopicScoreParams(
+            mesh_message_deliveries_weight=0.0,
+            mesh_failure_penalty_weight=0.0,
+        )
+
+    return PeerScoreParams(
+        topics={t: _quiet_safe() for t in topics},
+        default_topic=_quiet_safe())

@@ -22,6 +22,7 @@ from lighthouse_tpu.network import sync as sync_mod
 from lighthouse_tpu.network.gossip import ACCEPT, IGNORE, REJECT, GossipNode
 from lighthouse_tpu.network.peer_manager import PeerAction, PeerManager
 from lighthouse_tpu.network.rpc import RpcError, RpcHandler
+from lighthouse_tpu.network.scoring import eth2_score_params
 from lighthouse_tpu.network.types import (
     BlocksByRangeRequest,
     BlocksByRootRequest,
@@ -62,7 +63,13 @@ class NetworkService:
         self.processor = processor
         self.peer_manager = PeerManager()
         proxy = _NoRegisterTransport(transport)
-        self.gossip = GossipNode(peer_id, proxy, peer_manager=self.peer_manager)
+        # Client scoring profile: P3/P3b off until per-topic rate
+        # calibration exists (see eth2_score_params) — a node subscribes
+        # to quiet topics where mesh-delivery deficits would punish
+        # honest peers for topic silence.
+        self.gossip = GossipNode(peer_id, proxy,
+                                 peer_manager=self.peer_manager,
+                                 score_params=eth2_score_params())
         self.rpc = RpcHandler(peer_id, proxy, peer_manager=self.peer_manager)
         self.sync = sync_mod.SyncManager(self)
         self.fork_digest = compute_fork_digest(
@@ -73,6 +80,10 @@ class NetworkService:
         self._lc_seen_optimistic = 0
         self._lc_seen_finality = 0
         self._lock = threading.RLock()
+        # Poisoned-batch bisection reports its culprit back through here
+        # (attestation_verification/sync_committee batch paths): the origin
+        # peer eats a gossipsub P4 (app-topic) AND a RealScore penalty.
+        chain.peer_reporter = self.report_invalid_origin
         if hasattr(transport, "register"):
             transport.register(self)
         if hasattr(transport, "on_peer_connected"):
@@ -347,16 +358,27 @@ class NetworkService:
         self.chain.process_block(signed_block)
         self.sync.on_block_imported(signed_block)
 
+    def report_invalid_origin(self, peer_id: str, _reason: str = "") -> None:
+        """A batch-verified item this peer relayed turned out poisoned —
+        attributed after gossip validation (bisection), so the penalty
+        lands as gossipsub P4 under the app topic + a RealScore hit."""
+        self.gossip.scoring.reject_app_message(peer_id)
+        self.peer_manager.report_peer(peer_id, PeerAction.LOW_TOLERANCE)
+
     def _validate_attestation(self, topic: str, data: bytes, origin: str) -> str:
         try:
             att = self.chain.types.Attestation.deserialize(data)
         except Exception:
             return REJECT
         if self.processor is not None:
+            # Items carry their gossip origin into the batch so bisection
+            # can charge a poisoned signature to the relaying peer.
             self.processor.send(WorkEvent(
-                "gossip_attestation", att,
-                process_individual=lambda a: self._safe_att(a),
-                process_batch=lambda atts: self.chain.process_attestation_batch(atts),
+                "gossip_attestation", (att, origin),
+                process_individual=lambda pair: self._safe_att(pair[0]),
+                process_batch=lambda pairs: self.chain.process_attestation_batch(
+                    [a for a, _ in pairs], origins=[o for _, o in pairs]
+                ),
             ))
             return ACCEPT
         try:

@@ -121,6 +121,10 @@ class CostModelRouter:
                 f"Measured {route}-route batch verification latency")
             for route in ("cpu", "device")
         }
+        self._fallbacks = reg.counter_vec(
+            "serving_router_fallback_total",
+            "Device-route failures retried on the native CPU route",
+            "outcome")
 
     # -------------------------------------------------------------- routing
 
@@ -155,8 +159,26 @@ class CostModelRouter:
         self._reasons.labels(reason).inc()
         bucket = _next_pow2(max(1, len(sets)))
         t0 = time.perf_counter()
-        ok = bool(api.verify_signature_sets(
-            sets, backend=self.backend_name(route)))
+        try:
+            ok = bool(api.verify_signature_sets(
+                sets, backend=self.backend_name(route)))
+        except Exception:
+            # Robustness: a device-route exception (OOM, lost chip, bundle
+            # gone stale mid-slot) retries ONCE on the native CPU route
+            # instead of propagating mid-slot. A CPU-route failure has no
+            # further fallback and propagates.
+            if route != "device":
+                raise
+            self._fallbacks.labels("retried").inc()
+            route = "cpu"
+            t0 = time.perf_counter()
+            try:
+                ok = bool(api.verify_signature_sets(
+                    sets, backend=self.backend_name(route)))
+            except Exception:
+                self._fallbacks.labels("failed").inc()
+                raise
+            self._fallbacks.labels("recovered").inc()
         dt = time.perf_counter() - t0
         self.table.observe(route, bucket, dt)
         self._latency[route].observe(dt)

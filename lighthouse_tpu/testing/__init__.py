@@ -1,2 +1,6 @@
 """In-process test rigs (reference: beacon_chain/src/test_utils.rs harness,
 testing/node_test_rig, testing/simulator — SURVEY.md §4.3)."""
+
+from .faults import BEHAVIORS, FaultyPeer, apply_faults
+
+__all__ = ["BEHAVIORS", "FaultyPeer", "apply_faults"]

@@ -1,0 +1,138 @@
+"""Adversarial fault injection for gossip peers.
+
+The scoring engine (network/scoring.py) is only as real as the attacks it
+was tuned against. This module turns any `GossipNode` into a misbehaving
+peer by monkeypatching INSTANCE attributes — no subclass required — so
+the same behaviors run against in-process `SimTransport` swarms AND the
+full multi-process `proc_node` testnet over TCP (`init` takes a
+`"faults"` list).
+
+Behaviors (compose freely):
+
+  iwant_flood      every heartbeat, spray junk IWANT ids at every peer —
+                   bandwidth amplification; trips the per-heartbeat IWANT
+                   budget (P7 via IWANT_FLOOD_THRESHOLD).
+  ihave_spam       every heartbeat, advertise junk IHAVE ids that will
+                   never be delivered — victims record gossip promises
+                   that expire into P7 broken-promise penalties.
+  withhold         consume inbound gossip without ever forwarding or
+                   serving IWANT — mesh members starve (P3 deficit, then
+                   P3b on eviction). The eclipse attack's payload.
+  invalid_publish  every heartbeat, publish garbage on every subscribed
+                   topic — fails the victim's validator (REJECT → P4).
+  regraft_backoff  answer every PRUNE with an immediate re-GRAFT,
+                   violating the advertised backoff (P7 per attempt).
+
+`FaultyPeer` is the convenience constructor for sim worlds;
+`apply_faults` retrofits an already-built node (what proc_node uses).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterable, Optional, Set
+
+from lighthouse_tpu.network.gossip import (
+    GossipNode,
+    IWANT_FLOOD_THRESHOLD,
+    MAX_GOSSIP_SIZE,
+    MESSAGE_DOMAIN_VALID_SNAPPY,
+    _id_from_body,
+)
+from lighthouse_tpu.common import snappy as _snappy
+from lighthouse_tpu.network import pubsub_pb
+
+BEHAVIORS = (
+    "iwant_flood", "ihave_spam", "withhold", "invalid_publish",
+    "regraft_backoff",
+)
+
+# Per-heartbeat attack volumes.
+IWANT_FLOOD_IDS = IWANT_FLOOD_THRESHOLD + 64   # comfortably over budget
+IHAVE_SPAM_IDS = 32
+
+
+def _junk_ids(rng: random.Random, n: int) -> list:
+    return [bytes(rng.getrandbits(8) for _ in range(20)) for _ in range(n)]
+
+
+def apply_faults(node: GossipNode, behaviors: Iterable[str],
+                 rng: Optional[random.Random] = None) -> GossipNode:
+    """Install the named misbehaviors on `node` (instance-level patches).
+    Idempotent enough for one application; returns the node."""
+    active: Set[str] = set(behaviors)
+    unknown = active - set(BEHAVIORS)
+    if unknown:
+        raise ValueError(f"unknown fault behaviors: {sorted(unknown)}")
+    node.faults = active
+    rng = rng or node.rng
+    if not active:
+        return node
+
+    if "withhold" in active:
+        def _withhold_gossip(src: str, msg: dict) -> None:
+            # Consume: mark seen so IHAVE from others is not re-pulled,
+            # but never validate/forward/serve — mesh members starve.
+            topic, data = msg["topic"], msg["data"]
+            try:
+                body = _snappy.decompress(data, MAX_GOSSIP_SIZE)
+            except _snappy.SnappyError:
+                return
+            mid = _id_from_body(topic, body, MESSAGE_DOMAIN_VALID_SNAPPY)
+            with node._lock:
+                node._mark_seen(mid)
+
+        node._handle_gossip = _withhold_gossip
+
+    if "regraft_backoff" in active:
+        inner_handle_frame = node.handle_frame
+
+        def _regrafting_handle_frame(src: str, frame: tuple) -> None:
+            inner_handle_frame(src, frame)
+            if frame[0] != "gs":
+                return
+            try:
+                rpc = pubsub_pb.decode_rpc(frame[1])
+            except pubsub_pb.PbError:
+                return
+            control = rpc["control"] or {}
+            for topic, _backoff in control.get("prune", []):
+                # Protocol violation: GRAFT straight back inside the
+                # backoff window the victim just advertised.
+                node._send_rpc(src, {"control": {"graft": [topic]}})
+
+        node.handle_frame = _regrafting_handle_frame
+
+    inner_heartbeat = node.heartbeat
+
+    def _attacking_heartbeat() -> None:
+        inner_heartbeat()
+        with node._lock:
+            peers = list(node.peers)
+            topics = list(node.subscriptions) or \
+                list(node.peer_topics.keys())
+            if "iwant_flood" in active:
+                for p in peers:
+                    node._send_rpc(p, {"control": {
+                        "iwant": [_junk_ids(rng, IWANT_FLOOD_IDS)]}})
+            if "ihave_spam" in active:
+                for p in peers:
+                    for topic in topics:
+                        node._send_rpc(p, {"control": {"ihave": [
+                            (topic, _junk_ids(rng, IHAVE_SPAM_IDS))]}})
+            if "invalid_publish" in active:
+                for topic in topics:
+                    junk = bytes(rng.getrandbits(8) for _ in range(48))
+                    node.publish(topic, junk)
+
+    node.heartbeat = _attacking_heartbeat
+    return node
+
+
+class FaultyPeer(GossipNode):
+    """A GossipNode born hostile (sim-world convenience)."""
+
+    def __init__(self, peer_id: str, transport, behaviors: Iterable[str],
+                 **kwargs):
+        super().__init__(peer_id, transport, **kwargs)
+        apply_faults(self, behaviors)

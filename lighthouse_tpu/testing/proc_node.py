@@ -10,7 +10,8 @@ of a deployed node.
 
 Protocol (one JSON object per line):
   parent -> node: {"cmd": "init", "node_index": i, "n_nodes": n,
-                   "n_validators": v}
+                   "n_validators": v,
+                   "faults": ["withhold", ...]}        (optional)
   node -> parent: {"ok": true, "addr": [host, port]}
   parent -> node: {"cmd": "connect", "addr": [host, port]}
   parent -> node: {"cmd": "slot", "slot": s}   (run VC duties + tick)
@@ -18,6 +19,10 @@ Protocol (one JSON object per line):
   parent -> node: {"cmd": "status"}
   node -> parent: {"ok": true, "head": hex, "finalized_epoch": e,
                    "justified_epoch": e, "peers": [...]}
+  parent -> node: {"cmd": "peer_scores"}
+  node -> parent: {"ok": true, "scores": {peer: score},
+                   "breakdown": {peer: {p1..p7, p3b, score}},
+                   "mesh": {topic: [peers]}}
   parent -> node: {"cmd": "stop"}
 """
 
@@ -92,6 +97,11 @@ def main() -> None:
                     ),
                     client.chain.types, client.chain.spec,
                 )
+                faults = msg.get("faults") or []
+                if faults:
+                    from lighthouse_tpu.testing.faults import apply_faults
+
+                    apply_faults(client.network.gossip, faults)
                 _reply({"ok": True, "addr": list(transport.listen_addr)})
             elif cmd == "connect":
                 peer = client.network.connect_addr(tuple(msg["addr"]))
@@ -127,6 +137,18 @@ def main() -> None:
                     "finalized_epoch": int(chain.fork_choice.finalized.epoch),
                     "justified_epoch": int(chain.fork_choice.justified.epoch),
                     "peers": sorted(transport.connected_peers()),
+                })
+            elif cmd == "peer_scores":
+                g = client.network.gossip
+                snap = g.scoring.snapshot()
+                _reply({
+                    "ok": True,
+                    "scores": {p: round(b["score"], 4)
+                               for p, b in snap.items()},
+                    "breakdown": {p: {k: round(v, 4)
+                                      for k, v in b.items()}
+                                  for p, b in snap.items()},
+                    "mesh": {t: sorted(ps) for t, ps in g.mesh.items()},
                 })
             elif cmd == "stop":
                 _reply({"ok": True})

@@ -1,0 +1,443 @@
+"""Gossipsub v1.1 peer scoring: P1-P7 engine units, score-gated mesh
+maintenance, the score->PeerManager action flow, fault-injection behaviors,
+and the fast single-process eclipse-recovery scenario (the multi-process
+variant lives in test_transport.py, marked slow)."""
+
+import pytest
+
+from lighthouse_tpu.common import metrics as m
+from lighthouse_tpu.network import (
+    ACCEPT,
+    GossipNode,
+    PeerAction,
+    PeerManager,
+    PeerScore,
+    PeerScoreParams,
+    REJECT,
+    SimTransport,
+)
+from lighthouse_tpu.network.gossip import (
+    IWANT_FLOOD_THRESHOLD,
+    PRUNE_BACKOFF_HEARTBEATS,
+)
+from lighthouse_tpu.network.peer_manager import GOSSIP_SCORE_WEIGHT
+from lighthouse_tpu.network.scoring import APP_TOPIC
+from lighthouse_tpu.testing.faults import FaultyPeer, apply_faults
+
+TOPIC = "test/topic"
+
+
+# ---------------------------------------------------------------------------
+# PeerScore engine units (one component at a time)
+# ---------------------------------------------------------------------------
+
+
+def _engine(**overrides):
+    params = PeerScoreParams()
+    for k, v in overrides.items():
+        setattr(params, k, v)
+    ps = PeerScore(params)
+    ps.add_peer("p")
+    return ps
+
+
+def test_p1_time_in_mesh_accrues_and_caps():
+    ps = _engine()
+    ps.graft("p", TOPIC)
+    for _ in range(200):
+        ps.refresh_scores()
+    b = ps.breakdown("p")
+    tp = ps.params.topic_params(TOPIC)
+    assert b["p1"] == pytest.approx(
+        tp.time_in_mesh_weight * tp.time_in_mesh_cap)
+    assert b["p1"] > 0
+
+
+def test_p2_first_deliveries_decay():
+    ps = _engine()
+    for _ in range(5):
+        ps.deliver_message("p", TOPIC)
+    s_before = ps.score("p")
+    assert s_before > 0
+    for _ in range(30):
+        ps.refresh_scores()
+    assert ps.score("p") < s_before  # decayed back toward zero
+    assert ps.breakdown("p")["p2"] == 0.0
+
+
+def test_p3_deficit_needs_activation_then_bites():
+    ps = _engine()
+    ps.graft("p", TOPIC)
+    tp = ps.params.topic_params(TOPIC)
+    for _ in range(tp.mesh_message_deliveries_activation - 1):
+        ps.refresh_scores()
+    assert ps.breakdown("p")["p3"] == 0.0  # still inside the grace window
+    for _ in range(5):
+        ps.refresh_scores()
+    assert ps.breakdown("p")["p3"] < 0    # silent mesh member now penalized
+
+
+def test_p3b_sticky_failure_penalty_on_prune():
+    ps = _engine()
+    ps.graft("p", TOPIC)
+    for _ in range(10):
+        ps.refresh_scores()   # accrue a full deficit
+    ps.prune("p", TOPIC)
+    b = ps.breakdown("p")
+    assert b["p3"] == 0.0     # deficit is a mesh-member concept
+    assert b["p3b"] < 0       # ...but it stuck as the failure penalty
+
+
+def test_p4_invalid_messages_quadratic():
+    ps = _engine()
+    ps.reject_message("p", TOPIC)
+    one = ps.score("p")
+    ps.reject_message("p", TOPIC)
+    two = ps.score("p")
+    assert one < 0 and two < 4 * one * 0.99  # super-linear growth
+
+
+def test_p5_app_specific_feed():
+    params = PeerScoreParams()
+    ps = PeerScore(params, app_score_fn=lambda p: -40.0)
+    ps.add_peer("p")
+    assert ps.score("p") == pytest.approx(params.app_specific_weight * -40.0)
+
+
+def test_p6_ip_colocation_over_threshold():
+    ps = _engine()
+    thr = ps.params.ip_colocation_factor_threshold
+    for i in range(thr + 2):
+        ps.add_peer(f"sybil{i}", ip="10.0.0.9")
+    assert ps.score("sybil0") < 0          # swarm on one IP
+    ps.add_peer("lone", ip="10.0.0.10")
+    assert ps.score("lone") == 0.0         # solo IP unaffected
+
+
+def test_p7_behaviour_penalty_and_decay():
+    ps = _engine()
+    ps.add_penalty("p", 3.0)
+    assert ps.score("p") == pytest.approx(
+        ps.params.behaviour_penalty_weight * 9.0)
+    for _ in range(80):
+        ps.refresh_scores()
+    assert ps.score("p") == 0.0
+
+
+def test_disconnect_retains_negative_forgets_positive():
+    ps = _engine()
+    ps.add_penalty("p", 2.0)
+    ps.remove_peer("p")
+    assert ps.score("p") < 0               # negative state survives
+    ps.add_peer("good")
+    ps.deliver_message("good", TOPIC)
+    ps.remove_peer("good")
+    assert ps.score("good") == 0.0         # positive state forgotten
+    # retained-negative decays back to par and is dropped
+    for _ in range(200):
+        ps.refresh_scores()
+    assert "p" not in ps.snapshot()
+
+
+def test_eth2_client_profile_disables_uncalibrated_p3():
+    """The client profile (NetworkService) must not punish honest peers
+    for TOPIC silence: an eth2 node subscribes to quiet topics
+    (attester_slashing, LC updates) where nobody delivers for epochs.
+    P3/P3b are off until per-topic rate calibration; the rate-independent
+    components (P7 here) still bite."""
+    from lighthouse_tpu.network import eth2_score_params
+
+    ps = PeerScore(eth2_score_params(("topic/a",)))
+    ps.add_peer("p")
+    ps.graft("p", "topic/a")
+    ps.graft("p", "topic/quiet")
+    for _ in range(20):
+        ps.refresh_scores()
+    b = ps.breakdown("p")
+    assert b["p3"] == 0.0 and b["p3b"] == 0.0
+    assert ps.score("p") > 0                 # only P1 time-in-mesh accrues
+    ps.prune("p", "topic/quiet")
+    assert ps.breakdown("p")["p3b"] == 0.0   # no sticky penalty either
+    ps.add_penalty("p", 2.0)
+    assert ps.score("p") < 0                 # behaviour violations still do
+
+
+def test_topic_score_cap_limits_positive_sum():
+    ps = _engine(topic_score_cap=1.5)
+    for i in range(20):
+        t = f"t{i}"
+        ps.graft("p", t)
+        for _ in range(5):
+            ps.deliver_message("p", t)
+    assert ps.score("p") <= 1.5 + 1e-9
+
+
+# ---------------------------------------------------------------------------
+# Gossip-node integration: gates, backoff, action flow
+# ---------------------------------------------------------------------------
+
+
+def _pair(reg=None):
+    t = SimTransport()
+    a = GossipNode("ga", t, registry=reg)
+    b = GossipNode("gb", t, registry=reg)
+    t.connect(a, b)
+    a.subscribe(TOPIC)
+    b.subscribe(TOPIC)
+    return t, a, b
+
+
+def test_inbound_graft_rejected_inside_backoff_with_penalty():
+    reg = m.Registry()
+    _, a, b = _pair(reg)
+    with a._lock:
+        a._prune_peer(TOPIC, "gb")
+    assert "gb" not in a.mesh[TOPIC]
+    # b violates the advertised backoff:
+    a.handle_frame("gb", ("gs", _graft_frame()))
+    assert "gb" not in a.mesh[TOPIC]
+    assert a.scoring.breakdown("gb")["p7"] < 0
+    assert reg.counter_vec(
+        "gossip_peer_score_events_total", "", "event"
+    ).get("graft_rejected_backoff") >= 1
+
+
+def test_inbound_graft_rejected_on_negative_score():
+    reg = m.Registry()
+    _, a, b = _pair(reg)
+    a.mesh[TOPIC].discard("gb")
+    a.scoring.add_penalty("gb", 2.0)       # score < 0, no backoff
+    a.handle_frame("gb", ("gs", _graft_frame()))
+    assert "gb" not in a.mesh[TOPIC]
+    assert reg.counter_vec(
+        "gossip_peer_score_events_total", "", "event"
+    ).get("graft_rejected_score") >= 1
+
+
+def test_backoff_expires_and_graft_readmits():
+    _, a, b = _pair()
+    with a._lock:
+        a._prune_peer(TOPIC, "gb")
+    for _ in range(PRUNE_BACKOFF_HEARTBEATS + 2):
+        a.heartbeat()
+        b.heartbeat()
+    assert "gb" in a.mesh[TOPIC]           # re-grafted cleanly, no penalty
+    assert a.scoring.breakdown("gb")["p7"] == 0.0
+
+
+def test_graylist_drops_rpc_stream():
+    reg = m.Registry()
+    _, a, b = _pair(reg)
+    a.scoring.add_penalty("gb", 6.0)       # -5*36 = -180 < graylist -80
+    assert a.scoring.score("gb") <= a.scoring.params.graylist_threshold
+    a.handle_frame("gb", ("gs", _graft_frame()))
+    assert reg.counter_vec(
+        "gossip_peer_score_events_total", "", "event").get("graylisted") == 1
+
+
+def test_score_flow_bans_peer_in_peer_manager():
+    _, a, b = _pair()
+    a.scoring.add_penalty("gb", 6.0)
+    a.heartbeat()
+    # graylist-level gossip score blends into the manager's effective
+    # score below the ban threshold; the peer is dropped.
+    assert a.peer_manager.is_banned("gb")
+    assert "gb" not in a.peers
+
+
+def test_effective_score_blend_only_negative_gossip():
+    pm = PeerManager()
+    pm.peer_connected("p")
+    assert pm.update_gossip_score("p", 50.0) is None
+    assert pm.score("p") == 0.0            # positive gossip does NOT offset
+    assert pm.update_gossip_score("p", -40.0) == "disconnect"
+    assert pm.score("p") == pytest.approx(GOSSIP_SCORE_WEIGHT * -40.0)
+    assert pm.update_gossip_score("p", -80.0) == "ban"
+    assert pm.is_banned("p")
+
+
+def test_poisoned_batch_origin_charged_via_app_topic():
+    ps = PeerScore()
+    ps.add_peer("origin")
+    ps.reject_app_message("origin")
+    b = ps.breakdown("origin")
+    assert b["p4"] < 0
+    assert APP_TOPIC in ps._peers["origin"].topics
+
+
+def _graft_frame():
+    from lighthouse_tpu.network import pubsub_pb
+
+    return pubsub_pb.encode_rpc({"control": {"graft": [TOPIC]}})
+
+
+# ---------------------------------------------------------------------------
+# Fault-injection behaviors, one at a time
+# ---------------------------------------------------------------------------
+
+
+def test_fault_iwant_flood_trips_p7():
+    reg = m.Registry()
+    t = SimTransport()
+    victim = GossipNode("victim", t, registry=reg)
+    flooder = FaultyPeer("flood", t, ("iwant_flood",), registry=m.Registry())
+    t.connect(victim, flooder)
+    victim.subscribe(TOPIC)
+    flooder.subscribe(TOPIC)
+    flooder.heartbeat()                    # sprays > threshold junk IWANTs
+    assert victim._iwant_counts["flood"] >= IWANT_FLOOD_THRESHOLD
+    assert victim.scoring.breakdown("flood")["p7"] < 0
+    assert reg.counter_vec(
+        "gossip_peer_score_events_total", "", "event").get("iwant_flood") == 1
+
+
+def test_fault_ihave_spam_breaks_promises():
+    reg = m.Registry()
+    t = SimTransport()
+    victim = GossipNode("victim", t, registry=reg)
+    spammer = FaultyPeer("spam", t, ("ihave_spam",), registry=m.Registry())
+    t.connect(victim, spammer)
+    victim.subscribe(TOPIC)
+    spammer.subscribe(TOPIC)
+    spammer.heartbeat()                    # advertises junk ids
+    assert len(victim._promises) > 0       # victim recorded promises
+    for _ in range(4):
+        victim.heartbeat()                 # TTL passes, promises break
+    assert victim.scoring.breakdown("spam")["p7"] < 0
+    assert reg.counter_vec(
+        "gossip_peer_score_events_total", "", "event"
+    ).get("broken_promise") >= 1
+
+
+def test_fault_withhold_starves_mesh_and_evicts():
+    reg = m.Registry()
+    t = SimTransport()
+    victim = GossipNode("victim", t, registry=reg)
+    holder = FaultyPeer("hold", t, ("withhold",), registry=m.Registry())
+    helper = GossipNode("helper", t, registry=m.Registry())
+    t.connect(victim, holder)
+    t.connect(victim, helper)
+    t.connect(helper, holder)
+    for n in (victim, holder, helper):
+        n.subscribe(TOPIC)
+    victim.heartbeat()
+    assert "hold" in victim.mesh[TOPIC]
+    for rnd in range(8):
+        helper.publish(TOPIC, b"m%d" % rnd)
+        victim.heartbeat()
+    # the withholder forwarded nothing -> P3 deficit -> scored eviction
+    assert "hold" not in victim.mesh[TOPIC]
+    assert victim.scoring.breakdown("hold")["p3"] < 0 or \
+        victim.scoring.breakdown("hold")["p3b"] < 0
+    assert reg.counter_vec(
+        "gossip_peer_score_events_total", "", "event"
+    ).get("mesh_eviction") >= 1
+
+
+def test_fault_invalid_publish_earns_p4():
+    t = SimTransport()
+    victim = GossipNode("victim", t, registry=m.Registry())
+    liar = FaultyPeer("liar", t, ("invalid_publish",),
+                      registry=m.Registry())
+    t.connect(victim, liar)
+    victim.subscribe(TOPIC, validator=lambda t_, d, o: REJECT)
+    liar.subscribe(TOPIC)
+    victim.heartbeat()
+    liar.heartbeat()                       # publishes garbage
+    assert victim.scoring.breakdown("liar")["p4"] < 0
+
+
+def test_fault_regraft_inside_backoff_penalized():
+    t = SimTransport()
+    victim = GossipNode("victim", t, registry=m.Registry())
+    pest = FaultyPeer("pest", t, ("regraft_backoff",),
+                      registry=m.Registry())
+    t.connect(victim, pest)
+    victim.subscribe(TOPIC)
+    pest.subscribe(TOPIC)
+    victim.heartbeat()
+    with victim._lock:
+        victim._prune_peer(TOPIC, "pest")  # pest instantly re-GRAFTs
+    assert victim.scoring.breakdown("pest")["p7"] < 0
+    assert "pest" not in victim.mesh[TOPIC]
+
+
+def test_apply_faults_rejects_unknown_behavior():
+    t = SimTransport()
+    node = GossipNode("n", t, registry=m.Registry())
+    with pytest.raises(ValueError):
+        apply_faults(node, ["not_a_fault"])
+
+
+# ---------------------------------------------------------------------------
+# The fast eclipse scenario (tier-1 smoke; >=50% hostile)
+# ---------------------------------------------------------------------------
+
+
+def test_eclipse_recovery_with_majority_sybils():
+    """6 honest + 8 sybil (57% hostile) attacking with withholding, IWANT
+    floods, IHAVE spam and backoff-violating re-GRAFTs, pre-grafted into
+    the victim's mesh: scored eviction + opportunistic grafting must
+    recover a majority-honest mesh without delivery ever stopping."""
+    reg = m.Registry()
+    other = m.Registry()
+    t = SimTransport()
+    victim = GossipNode("victim", t, registry=reg)
+    honest = [GossipNode(f"h{i}", t, registry=other) for i in range(6)]
+    sybils = [
+        FaultyPeer(
+            f"sybil{i}", t,
+            ("withhold", "iwant_flood", "ihave_spam", "regraft_backoff"),
+            registry=other,
+        )
+        for i in range(8)
+    ]
+    victim.subscribe(TOPIC, validator=lambda t_, d, o: ACCEPT)
+    for n in honest + sybils:
+        n.subscribe(TOPIC)
+    for n in honest + sybils:
+        t.connect(victim, n)
+    for i, a in enumerate(honest):
+        for b in honest[i + 1:]:
+            t.connect(a, b)
+    # The eclipse: sybils graft first while their scores are still clean.
+    sybil_ids = {s.peer_id for s in sybils}
+    for s in sybils:
+        with victim._lock:
+            victim._handle_graft(s.peer_id, TOPIC)
+        s.mesh.setdefault(TOPIC, set()).add("victim")
+    assert len(victim.mesh[TOPIC] & sybil_ids) == 8  # eclipsed
+
+    delivered = 0
+    rounds = 14
+    for rnd in range(rounds):
+        before = len(victim._seen)
+        honest[rnd % len(honest)].publish(TOPIC, b"payload-%d" % rnd)
+        for node in [victim] + honest + sybils:
+            node.heartbeat()
+        delivered += len(victim._seen) > before
+
+    mesh = victim.mesh[TOPIC]
+    n_sybil = len(mesh & sybil_ids)
+    n_honest = len(mesh - sybil_ids)
+    assert n_honest > n_sybil              # majority-honest again
+    assert n_sybil == 0                    # and in fact fully cleansed
+    assert delivered >= rounds - 2         # delivery never (meaningfully) dropped
+
+    # Per-counter scoring metrics asserted end to end:
+    ev = reg.counter_vec("gossip_peer_score_events_total", "", "event")
+    assert ev.get("mesh_eviction") >= 1
+    assert ev.get("graft_rejected_backoff") >= 1
+    assert ev.get("broken_promise") >= 1
+    assert ev.get("iwant_flood") >= 1
+    assert ev.get("graylisted") >= 1
+    assert ev.get("score_ban") + ev.get("score_disconnect") >= 8
+    # Sybils ended banned at the peer manager via the score flow.
+    assert all(victim.peer_manager.is_banned(s) or
+               victim.peer_manager.score(s) < 0 for s in sybil_ids)
+    # The scoring breakdown names the crimes (any surviving sybil entry
+    # carries behaviour penalties; evicted-while-negative state is
+    # retained on disconnect).
+    snap = victim.scoring.snapshot()
+    sybil_entries = [b for p, b in snap.items() if p in sybil_ids]
+    assert sybil_entries and all(b["score"] < 0 for b in sybil_entries)

@@ -329,6 +329,142 @@ def test_scheduler_deadline_close():
     assert sched.depth() == 0
 
 
+def _deadline_rig(close_margin_s, cpu_latency=None, registry=None,
+                  **sched_kw):
+    """Scheduler + router + manual clock at slot start (4s third)."""
+    from lighthouse_tpu.common.slot_clock import ManualSlotClock
+    from lighthouse_tpu.crypto.bls import api
+    from lighthouse_tpu.serving.router import CostModelRouter, LatencyTable
+    from lighthouse_tpu.serving.scheduler import ContinuousBatchScheduler
+
+    api.register_backend("_test_dl_edge", lambda sets: True)
+    t = LatencyTable()
+    if cpu_latency is not None:
+        t.seed("cpu", 1, cpu_latency)
+    router = CostModelRouter(table=t, cpu_backend="_test_dl_edge",
+                             small_batch_max=16,
+                             registry=_fresh_registry())
+    clock = ManualSlotClock(genesis_time=0, seconds_per_slot=12)
+    clock.set_slot(10)
+    sched = ContinuousBatchScheduler(
+        clock, router=router, close_margin_s=close_margin_s,
+        registry=registry or _fresh_registry(), **sched_kw)
+    return clock, sched
+
+
+def test_scheduler_closes_exactly_at_deadline_boundary():
+    """Edge: predicted latency EXACTLY equals the remaining budget (zero
+    margin) — the <= close condition must fire, not wait one more step.
+    All values are exact binary fractions so there is no float slop."""
+    from lighthouse_tpu.serving.scheduler import VerifyJob
+
+    clock, sched = _deadline_rig(close_margin_s=0.0, cpu_latency=0.5)
+    sched.submit(VerifyJob("gossip_attestation", "x"))
+    clock.advance_seconds(3.25)              # budget 0.75 > 0.5: wait
+    assert not sched.step()
+    clock.advance_seconds(0.25)              # budget 0.5 == predicted 0.5
+    assert sched.step()
+    assert sched.stats.batches == 1
+    assert sched.stats.deadline_hits == 1    # instant backend fits 0.5s
+
+
+def test_scheduler_deadline_already_past_at_enqueue():
+    """Edge: the job arrives with less budget left than the predicted
+    latency — the very first step must dispatch (cause: deadline), not
+    accumulate into the next slot third."""
+    from lighthouse_tpu.serving.scheduler import VerifyJob
+
+    reg = _fresh_registry()
+    clock, sched = _deadline_rig(close_margin_s=0.05, cpu_latency=0.5,
+                                 registry=reg)
+    clock.advance_seconds(3.9)               # budget 0.1 < 0.5 predicted
+    sched.submit(VerifyJob("gossip_attestation", "late"))
+    assert sched.step()                      # no waiting: dispatch NOW
+    assert sched.stats.batches == 1
+    assert sched.depth() == 0
+    assert reg.counter_vec(
+        "serving_scheduler_close_total").get("deadline") == 1
+
+
+def test_scheduler_zero_latency_estimate_first_batch():
+    """Edge: a 0.0s table entry (warming measured an instant backend).
+    The batch must still close — inside the margin of the third's end —
+    rather than waiting forever because 'it will always fit'."""
+    from lighthouse_tpu.serving.scheduler import VerifyJob
+
+    clock, sched = _deadline_rig(close_margin_s=0.05, cpu_latency=0.0)
+    sched.submit(VerifyJob("gossip_attestation", "x"))
+    clock.advance_seconds(3.9)               # budget 0.1 > margin: wait
+    assert not sched.step()
+    clock.advance_seconds(0.0625)            # budget 0.0375 <= margin
+    assert sched.step()
+    assert sched.stats.batches == 1
+
+
+def test_scheduler_unmeasured_first_batch_uses_default_latency():
+    """Edge: NO table data at all for the first batch — the conservative
+    default_latency_s stands in, so the close still happens a default's
+    width before the boundary instead of at depth-0-forever."""
+    from lighthouse_tpu.serving.scheduler import VerifyJob
+
+    clock, sched = _deadline_rig(close_margin_s=0.05, cpu_latency=None,
+                                 default_latency_s=0.25)
+    sched.submit(VerifyJob("gossip_attestation", "x"))
+    clock.advance_seconds(3.5)               # budget 0.5: 0.5-0.25 > 0.05
+    assert not sched.step()
+    clock.advance_seconds(0.25)              # budget 0.25 - 0.25 <= margin
+    assert sched.step()
+    assert sched.stats.batches == 1
+
+
+def test_router_device_failure_retries_on_cpu(monkeypatch):
+    """Satellite: a device-route exception (lost chip, stale bundle)
+    retries once on the native CPU route, counted in
+    serving_router_fallback_total; CPU failures propagate unretried."""
+    from lighthouse_tpu.crypto.bls import api
+    from lighthouse_tpu.serving.router import CostModelRouter, LatencyTable
+
+    def _boom(sets):
+        raise RuntimeError("device lost")
+
+    api.register_backend("_test_fb_boom", _boom)
+    api.register_backend("_test_fb_ok", lambda sets: True)
+
+    # Device raises, cpu recovers: verify succeeds on the fallback route.
+    reg = _fresh_registry()
+    r = CostModelRouter(table=LatencyTable(), cpu_backend="_test_fb_ok",
+                        device_backend="_test_fb_boom", small_batch_max=0,
+                        registry=reg)
+    ok, route = r.verify(["a", "b"])
+    assert ok and route == "cpu"
+    fb = reg.counter_vec("serving_router_fallback_total")
+    assert fb.get("retried") == 1
+    assert fb.get("recovered") == 1
+    assert fb.get("failed") == 0
+    # The recovered run's latency was still measured (for the cpu route).
+    assert r.table.predict("cpu", 2) is not None
+
+    # Both routes raise: the failure propagates and is counted.
+    reg2 = _fresh_registry()
+    r2 = CostModelRouter(table=LatencyTable(), cpu_backend="_test_fb_boom",
+                         device_backend="_test_fb_boom", small_batch_max=0,
+                         registry=reg2)
+    with pytest.raises(RuntimeError):
+        r2.verify(["a", "b"])
+    fb2 = reg2.counter_vec("serving_router_fallback_total")
+    assert fb2.get("retried") == 1
+    assert fb2.get("failed") == 1
+
+    # A cpu-route failure has no further fallback: no retry counted.
+    reg3 = _fresh_registry()
+    r3 = CostModelRouter(table=LatencyTable(), cpu_backend="_test_fb_boom",
+                         small_batch_max=16, registry=reg3)
+    with pytest.raises(RuntimeError):
+        r3.verify(["a"])                     # small -> cpu route
+    fb3 = reg3.counter_vec("serving_router_fallback_total")
+    assert fb3.get("retried") == 0
+
+
 def test_serve_dry_run(toy_bundle_dir):
     """Satellite 6 smoke: bundle verify + warmer + scheduler + router
     drain a mixed attestation/sync-committee workload deterministically,

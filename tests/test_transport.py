@@ -329,6 +329,85 @@ def test_three_process_testnet_finalizes():
             p.terminate()
 
 
+@pytest.mark.slow
+def test_three_process_testnet_scored_eviction():
+    """The adversarial socket-layer gate (ISSUE 12 acceptance): node 2
+    runs the fault-injection harness over REAL TCP — withholding, IWANT
+    floods, IHAVE spam, backoff-violating re-GRAFTs — while nodes 0 and 1
+    stay honest. Gossipsub v1.1 scoring must drive the attacker's score
+    negative (P7-dominated) and out of every mesh on the victim, without
+    the honest pair's delivery or convergence suffering."""
+    import json
+    import subprocess
+    import sys
+
+    N, V = 3, 24
+    FAULTS = ["withhold", "iwant_flood", "ihave_spam", "regraft_backoff"]
+    procs = []
+
+    def send(p, obj, timeout=60.0):
+        p.stdin.write(json.dumps(obj) + "\n")
+        p.stdin.flush()
+        line = p.stdout.readline()
+        assert line, "node died"
+        out = json.loads(line)
+        assert out.get("ok"), out
+        return out
+
+    try:
+        for i in range(N):
+            p = subprocess.Popen(
+                [sys.executable, "-m", "lighthouse_tpu.testing.proc_node"],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, text=True, cwd="/root/repo",
+            )
+            procs.append(p)
+        addrs = []
+        for i, p in enumerate(procs):
+            init = {"cmd": "init", "node_index": i, "n_nodes": N,
+                    "n_validators": V}
+            if i == 2:
+                init["faults"] = FAULTS
+            addrs.append(send(p, init)["addr"])
+        peer_of = {}
+        for i in range(N):
+            for j in range(i + 1, N):
+                out = send(procs[i], {"cmd": "connect", "addr": addrs[j]})
+                peer_of[(i, j)] = out["peer"]
+        faulty_id = peer_of[(0, 2)]
+        honest_id = peer_of[(0, 1)]
+
+        per_epoch = 8  # minimal preset
+        for slot in range(1, 2 * per_epoch + 1):
+            for p in procs:
+                send(p, {"cmd": "slot", "slot": slot})
+            for p in procs:
+                send(p, {"cmd": "settle"})
+
+        # The victim's scorebook names the attacker (state retained even
+        # if the score-ban flow already dropped the gossip connection).
+        scores = send(procs[0], {"cmd": "peer_scores"})
+        assert scores["scores"].get(faulty_id, 0.0) < 0, scores["scores"]
+        assert scores["breakdown"][faulty_id]["p7"] < 0, scores["breakdown"]
+        assert scores["scores"].get(honest_id, 0.0) >= 0, scores["scores"]
+        for topic, members in scores["mesh"].items():
+            assert faulty_id not in members, (topic, members)
+
+        # Honest delivery survived: both honest nodes converge on a head
+        # that kept advancing through the attack.
+        s0 = send(procs[0], {"cmd": "status"})
+        s1 = send(procs[1], {"cmd": "status"})
+        assert s0["head"] == s1["head"], (s0, s1)
+        assert s0["head_slot"] >= per_epoch, s0
+    finally:
+        for p in procs:
+            try:
+                send(p, {"cmd": "stop"}, timeout=5.0)
+            except Exception:
+                pass
+            p.terminate()
+
+
 def test_noise_handshake_vectors_and_properties():
     """Noise_XX_25519_ChaChaPoly_SHA256 state machine: both sides derive
     the same handshake hash and opposite cipher pairs; payloads are
