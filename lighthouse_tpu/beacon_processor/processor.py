@@ -154,6 +154,7 @@ class BeaconProcessor:
         max_batch: int = DEFAULT_MAX_BATCH,
         max_workers: int = 4,
         batch_policy: Optional[AdaptiveBatchPolicy] = None,
+        registry=None,
     ):
         self.max_batch = max_batch
         self.batch_policy = batch_policy   # None => fixed max_batch (CPU)
@@ -165,6 +166,23 @@ class BeaconProcessor:
         self._work_ready = threading.Condition(self._lock)
         self._running = False
         self._thread: Optional[threading.Thread] = None
+        # Per-work-type metrics (the reference's beacon_processor gauge +
+        # counter family, lib.rs's *_QUEUE_TOTAL / *_WORK_* mirrors).
+        from lighthouse_tpu.common import metrics as m
+
+        reg = registry or m.REGISTRY
+        self._m_depth = reg.gauge_vec(
+            "beacon_processor_queue_depth",
+            "Current queue depth, by work type", "kind")
+        self._m_processed = reg.counter_vec(
+            "beacon_processor_processed_total",
+            "Work items completed, by work type", "kind")
+        self._m_dropped = reg.counter_vec(
+            "beacon_processor_dropped_total",
+            "Work items dropped at a full queue, by work type", "kind")
+        self._m_batches = reg.counter(
+            "beacon_processor_batches_total",
+            "Batch work items formed from batchable queues")
 
     # ---------------------------------------------------------------- intake
 
@@ -175,8 +193,10 @@ class BeaconProcessor:
             q = self.queues[event.kind]
             if len(q) >= QUEUE_CAPS[event.kind]:
                 self.stats.dropped += 1
+                self._m_dropped.labels(event.kind).inc()
                 return False
             q.append(event)
+            self._m_depth.labels(event.kind).set(len(q))
             self._work_ready.notify()
             return True
 
@@ -195,8 +215,11 @@ class BeaconProcessor:
                 batch = []
                 while q and len(batch) < limit:
                     batch.append(q.popleft())
+                self._m_depth.labels(kind).set(len(q))
                 return batch
-            return [q.popleft()]
+            ev = q.popleft()
+            self._m_depth.labels(kind).set(len(q))
+            return [ev]
         return None
 
     def step(self) -> bool:
@@ -208,6 +231,7 @@ class BeaconProcessor:
         if len(work) > 1:
             self.stats.batches += 1
             self.stats.batched_items += len(work)
+            self._m_batches.inc()
             batch_fn = work[0].process_batch
             if self.batch_policy is not None and batch_fn is not None:
                 # Only a REAL device batch warms a bucket shape: a kind
@@ -225,6 +249,7 @@ class BeaconProcessor:
             self.stats.processed += 1
             if w.process_individual:
                 w.process_individual(w.item)
+        self._m_processed.labels(work[0].kind).inc(len(work))
         if len(work) == 1:
             return True
         self.stats.processed += len(work)

@@ -130,11 +130,17 @@ class ShapeWarmer:
         """Warm one bucket shape: bundle verify fast path first, else
         compile + execute on masked synthetic tensors (whichever engine
         the layout selector routes this process to)."""
-        if self._warm_from_bundle(n_bucket, k_bucket):
-            self.bundle_warmed.append((n_bucket, k_bucket))
-            return
-        self.compiled.append((n_bucket, k_bucket))
-        self._warm_compile(n_bucket, k_bucket)
+        from lighthouse_tpu.observability import compile_events, trace
+
+        with trace.span("warm_one", cat="warming",
+                        n=n_bucket, k=k_bucket):
+            if self._warm_from_bundle(n_bucket, k_bucket):
+                self.bundle_warmed.append((n_bucket, k_bucket))
+                return
+            self.compiled.append((n_bucket, k_bucket))
+            compile_events.record("warm_compile_path",
+                                  n=n_bucket, k=k_bucket)
+            self._warm_compile(n_bucket, k_bucket)
 
     def _warm_compile(self, n_bucket: int, k_bucket: int) -> None:
         """The compile path (trace + lower + execute; persistent-cache
@@ -226,6 +232,15 @@ class ShapeWarmer:
             core(*args)
 
     def _run(self) -> None:
+        try:
+            # Warming is where compiles happen: make sure the provenance
+            # hooks (persistent-cache hit/miss, compile durations) are
+            # live before the first shape.
+            from lighthouse_tpu.observability import compile_events
+
+            compile_events.install()
+        except Exception:
+            pass
         try:
             from lighthouse_tpu.ops.backend import max_n_bucket
 

@@ -1,0 +1,53 @@
+"""Observability spine: span tracing, stage timers, compile-event
+accounting, and the shared probe-report schema (ROADMAP Open item 2's
+measurement layer).
+
+Four cooperating pieces:
+
+  * `trace`          — process-global span tracer with Chrome
+                       trace-event JSON export; off by default, one
+                       attribute read when disabled.
+  * `stages`         — `traced(engine, stage, fn)` wrappers the engine
+                       builders apply to every stage callable; active
+                       tracing adds the `block_until_ready` seam and
+                       feeds `engine_stage_seconds{engine,stage}`.
+  * `compile_events` — executable-provenance counters (first compile vs
+                       persistent-cache hit vs warm-bundle hit) plus
+                       jax-internal monitoring hooks.
+  * `report`         — the one probe-script JSON envelope.
+
+Everything degrades to no-ops rather than raising: instrumentation must
+never be the thing that takes the batch path down.
+
+Submodules import lazily (PEP 562): `ops.backend` and `serving.aot`
+consult this package from inside builders, and an eager import of
+`stages` (which imports `common.metrics`) from those seams would cycle
+through `lighthouse_tpu` package init.
+"""
+
+_SUBMODULES = ("trace", "stages", "compile_events", "report")
+
+__all__ = [
+    "trace", "stages", "compile_events", "report",
+    "Tracer", "TRACER", "span", "instant", "enable", "disable",
+]
+
+_EXPORTS = {
+    "Tracer": ("trace", "Tracer"),
+    "TRACER": ("trace", "TRACER"),
+    "span": ("trace", "span"),
+    "instant": ("trace", "instant"),
+    "enable": ("trace", "enable"),
+    "disable": ("trace", "disable"),
+}
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in _SUBMODULES:
+        return importlib.import_module(f".{name}", __name__)
+    if name in _EXPORTS:
+        mod, attr = _EXPORTS[name]
+        return getattr(importlib.import_module(f".{mod}", __name__), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,74 @@
+"""Per-stage engine timers.
+
+Both engines build their stages once per bucket shape (`_jitted_core`)
+and then dispatch them asynchronously; XLA returns control before the
+work finishes, so a naive wall-clock around the call measures dispatch,
+not execution. `traced()` wraps a built stage callable with the one
+correct seam: when tracing is active it calls the stage, blocks until
+the result is ready, and records the true device wall time as a span
+plus an `engine_stage_seconds{engine,stage}` histogram sample. When
+tracing is inactive (the production default) the wrapper is a single
+attribute check and the engines keep their async pipelining — stages
+overlap host staging exactly as before.
+
+`force_timing(True)` turns the seams on without buffering trace events,
+for long-running servers that want the /metrics histograms but not an
+unbounded trace.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+from lighthouse_tpu.common import metrics as m
+from lighthouse_tpu.observability import trace
+
+# Stage wall times span ~1ms (warm tiny buckets) to minutes (first-call
+# compiles on a cold cache), so the default ms-centric buckets are wrong.
+STAGE_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 120.0)
+
+_force_timing = False
+
+
+def force_timing(on: bool = True) -> None:
+    global _force_timing
+    _force_timing = on
+
+
+def timing_active() -> bool:
+    return trace.TRACER.enabled or _force_timing
+
+
+def stage_seconds(registry: m.Registry = None) -> m.LabeledHistogram:
+    return (registry or m.REGISTRY).histogram_vec(
+        "engine_stage_seconds",
+        "Blocked per-stage engine wall time (only sampled while stage "
+        "timing is active; first calls include compile)",
+        labels=("engine", "stage"), buckets=STAGE_BUCKETS)
+
+
+def traced(engine: str, stage: str, fn: Callable, **static_args) -> Callable:
+    """Wrap a built stage callable. `static_args` (bucket shape etc.)
+    are stamped into each span's args, not into metric labels — shapes
+    are unbounded-cardinality and belong in the trace, not /metrics."""
+    hist = stage_seconds()
+
+    def wrapped(*args):
+        if not (trace.TRACER.enabled or _force_timing):
+            return fn(*args)
+        import jax  # deferred: the tracer itself has no jax dependency
+
+        t0 = time.perf_counter()
+        with trace.span(f"{engine}:{stage}", cat="stage",
+                        engine=engine, stage=stage, **static_args):
+            out = fn(*args)
+            jax.block_until_ready(out)
+        hist.labels(engine=engine, stage=stage).observe(
+            time.perf_counter() - t0)
+        return out
+
+    wrapped.__name__ = f"traced_{stage}"
+    wrapped.__wrapped__ = fn
+    return wrapped

@@ -122,3 +122,13 @@ try:
         pass
 except Exception:  # pragma: no cover - cache is an optimization only
     pass
+
+# Executable-provenance hooks: any process that imports the engine gets
+# persistent-cache hit/miss counters and backend-compile durations on
+# /metrics (observability/compile_events.py rides jax's monitoring bus).
+try:
+    from lighthouse_tpu.observability import compile_events as _compile_events
+
+    _compile_events.install()
+except Exception:  # pragma: no cover - observability only
+    pass

@@ -74,6 +74,19 @@ def _warm_dispatch(stage_id: str, fallback):
         return fallback
 
 
+def _traced(stage: str, fn, **static_args):
+    """Observability stage wrapper (observability/stages.traced): a
+    no-op attribute check unless tracing/stage-timing is active, in
+    which case the stage blocks until ready and reports its true wall
+    time. Guarded for the same reason as _warm_dispatch."""
+    try:
+        from lighthouse_tpu.observability import stages as _obs_stages
+
+        return _obs_stages.traced("major", stage, fn, **static_args)
+    except Exception:
+        return fn
+
+
 # ---------------------------------------------------------------------------
 # Jitted core (cached per bucket shape)
 # ---------------------------------------------------------------------------
@@ -161,11 +174,16 @@ def _jitted_core(n_bucket: int, k_bucket: int, sharded: bool,
                  n_devices: Optional[int] = None):
     """Three-stage pipeline, each stage its own jit (own cache entry).
     `n_devices` bounds the sharded mesh (default: all devices)."""
-    del n_bucket, k_bucket  # cache key only; shapes live in the arguments
+    shape_args = dict(n=n_bucket, k=k_bucket, sharded=sharded)
     if not sharded:
-        stage1 = _warm_dispatch("h2g2", jax.jit(_h2g2_gather))
-        stage2 = _warm_dispatch("prepare", jax.jit(_prepare_pairs))
-        stage3 = _warm_dispatch("pairing", jax.jit(_pairing_check))
+        stage1 = _traced("h2g2", _warm_dispatch("h2g2", jax.jit(_h2g2_gather)),
+                         **shape_args)
+        stage2 = _traced("prepare",
+                         _warm_dispatch("prepare", jax.jit(_prepare_pairs)),
+                         **shape_args)
+        stage3 = _traced("pairing",
+                         _warm_dispatch("pairing", jax.jit(_pairing_check)),
+                         **shape_args)
 
         def core(u, inv_idx, pk_proj, sig_proj, sig_checked, set_mask,
                  scalars):
@@ -175,6 +193,7 @@ def _jitted_core(n_bucket: int, k_bucket: int, sharded: bool,
             )
             return stage3(p_proj, h_proj, s_proj, set_mask, sets_valid)
 
+        core.stages = (stage1, stage2, stage3)
         return core
 
     from lighthouse_tpu.parallel import mesh as pm
@@ -200,9 +219,11 @@ def _jitted_core(n_bucket: int, k_bucket: int, sharded: bool,
                 return fn(*args)
         return wrapped
 
-    stage1 = jax.jit(constrained(_h2g2_gather))
-    stage2 = jax.jit(constrained(_prepare_pairs))
-    stage3 = jax.jit(unfused(_pairing_check))  # (n+1): leave layout to XLA
+    stage1 = _traced("h2g2", jax.jit(constrained(_h2g2_gather)), **shape_args)
+    stage2 = _traced("prepare", jax.jit(constrained(_prepare_pairs)),
+                     **shape_args)
+    # (n+1): leave layout to XLA
+    stage3 = _traced("pairing", jax.jit(unfused(_pairing_check)), **shape_args)
 
     def core(u, inv_idx, pk_proj, sig_proj, sig_checked, set_mask, scalars):
         h_proj = stage1(u, inv_idx)
@@ -211,6 +232,7 @@ def _jitted_core(n_bucket: int, k_bucket: int, sharded: bool,
         )
         return stage3(p_proj, h_proj, s_proj, set_mask, sets_valid)
 
+    core.stages = (stage1, stage2, stage3)
     return core
 
 

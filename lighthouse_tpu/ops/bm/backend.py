@@ -252,6 +252,17 @@ def _warm_dispatch(stage_id: str, fallback):
         return fallback
 
 
+def _traced(stage: str, fn, **static_args):
+    """Observability stage wrapper (see ops/backend._traced), engine
+    label "bm"."""
+    try:
+        from lighthouse_tpu.observability import stages as _obs_stages
+
+        return _obs_stages.traced("bm", stage, fn, **static_args)
+    except Exception:
+        return fn
+
+
 def jitted_core(n_bucket: int, k_bucket: int, m_bucket: int,
                 prep_chunk: Optional[int] = None, sharded: bool = False,
                 n_devices: Optional[int] = None):
@@ -276,6 +287,8 @@ def jitted_core(n_bucket: int, k_bucket: int, m_bucket: int,
 def _jitted_core(n_bucket: int, k_bucket: int, m_bucket: int,
                  prep_chunk: int, sharded: bool,
                  n_devices: Optional[int]):
+    shape_args = dict(n=n_bucket, k=k_bucket, m=m_bucket,
+                      chunk=prep_chunk, sharded=sharded)
     del n_bucket, k_bucket  # cache keys; shapes live in the arguments
     if not sharded:
         stage1 = _warm_dispatch("h2g2", _stage1_jit)
@@ -305,6 +318,10 @@ def _jitted_core(n_bucket: int, k_bucket: int, m_bucket: int,
         stage1 = jax.jit(constrained(_h2g2))
         stage2 = jax.jit(constrained(_make_prepare(m_bucket, prep_chunk)))
         stage3 = jax.jit(_pairing_check)
+
+    stage1 = _traced("h2g2", stage1, **shape_args)
+    stage2 = _traced("prepare", stage2, **shape_args)
+    stage3 = _traced("pairing", stage3, **shape_args)
 
     def core(u, inv_idx, row_mask, pk_proj, sig_proj, sig_checked,
              set_mask, scalars):

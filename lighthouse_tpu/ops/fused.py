@@ -257,8 +257,28 @@ def _fwd_consts(n_p: int):
     return off, v, p_row, inv_row
 
 
+def _note_kernel_build(kernel: str, **shape_args) -> None:
+    """One event per distinct Pallas kernel instantiation (fires at
+    lru_cache miss inside the builders, i.e. at trace time, never inside
+    the compiled graph). Guarded: observability must not break kernels."""
+    try:
+        from lighthouse_tpu.common.metrics import REGISTRY
+        from lighthouse_tpu.observability import trace
+
+        REGISTRY.counter_vec(
+            "engine_pallas_kernel_builds_total",
+            "Distinct Pallas kernel instantiations, by kernel",
+            "kernel").labels(kernel).inc()
+        trace.instant(f"pallas_build:{kernel}", cat="compile",
+                      **shape_args)
+    except Exception:
+        pass
+
+
 @lru_cache(maxsize=None)
 def _fwd_call(rows_p: int, blk: int, n_p: int, interpret: bool):
+    _note_kernel_build("ntt_fwd", rows_p=rows_p, blk=blk, n_p=n_p)
+
     def kernel(x_ref, off_ref, v_ref, p_ref, ip_ref, o_ref):
         # Constants stay 2D ((1, n) broadcasts): Mosaic rejects 1D vectors.
         o_ref[:, :] = _fwd_body(
@@ -298,6 +318,8 @@ def _inv_consts(n_p: int, with_offset: bool):
 @lru_cache(maxsize=None)
 def _inv_call(rows_p: int, blk: int, n_p: int, with_offset: bool,
               interpret: bool):
+    _note_kernel_build("ntt_inv", rows_p=rows_p, blk=blk, n_p=n_p,
+                       with_offset=with_offset)
     plan = _plan(n_p)
     nfold = lb._T_FOLD_NP.shape[0]
 

@@ -87,6 +87,16 @@ def reset_stats() -> None:
         _STATS.hits = _STATS.misses = _STATS.corrupt = _STATS.stale = 0
 
 
+# Bundle outcomes double as executable-provenance events in the
+# observability layer's vocabulary (observability/compile_events.py).
+_PROVENANCE = {
+    "hits": "warm_bundle_hit",
+    "misses": "warm_bundle_miss",
+    "corrupt": "bundle_corrupt",
+    "stale": "bundle_stale",
+}
+
+
 def _count(attr: str, n: int = 1) -> None:
     with _STATS_LOCK:
         setattr(_STATS, attr, getattr(_STATS, attr) + n)
@@ -98,6 +108,13 @@ def _count(attr: str, n: int = 1) -> None:
             "Warm-bundle stage resolutions by outcome", "outcome",
         ).labels(attr).inc(n)
     except Exception:  # metrics are observability only
+        pass
+    try:
+        from lighthouse_tpu.observability import compile_events
+
+        for _ in range(n):
+            compile_events.record(_PROVENANCE[attr])
+    except Exception:
         pass
 
 

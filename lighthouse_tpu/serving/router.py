@@ -29,6 +29,7 @@ import time
 from typing import Dict, Optional, Sequence, Tuple
 
 from lighthouse_tpu.common import metrics as m
+from lighthouse_tpu.observability import trace
 
 
 def _next_pow2(n: int) -> int:
@@ -157,11 +158,15 @@ class CostModelRouter:
         route, reason = self.route(len(sets), deadline_budget)
         self._routes.labels(route).inc()
         self._reasons.labels(reason).inc()
+        trace.instant("router:decision", cat="lifecycle", route=route,
+                      reason=reason, n_sets=len(sets))
         bucket = _next_pow2(max(1, len(sets)))
         t0 = time.perf_counter()
         try:
-            ok = bool(api.verify_signature_sets(
-                sets, backend=self.backend_name(route)))
+            with trace.span("router:verify", cat="lifecycle",
+                            route=route, n_sets=len(sets)):
+                ok = bool(api.verify_signature_sets(
+                    sets, backend=self.backend_name(route)))
         except Exception:
             # Robustness: a device-route exception (OOM, lost chip, bundle
             # gone stale mid-slot) retries ONCE on the native CPU route
@@ -173,8 +178,10 @@ class CostModelRouter:
             route = "cpu"
             t0 = time.perf_counter()
             try:
-                ok = bool(api.verify_signature_sets(
-                    sets, backend=self.backend_name(route)))
+                with trace.span("router:verify_fallback", cat="lifecycle",
+                                route=route, n_sets=len(sets)):
+                    ok = bool(api.verify_signature_sets(
+                        sets, backend=self.backend_name(route)))
             except Exception:
                 self._fallbacks.labels("failed").inc()
                 raise

@@ -50,21 +50,31 @@ from lighthouse_tpu.beacon_processor.processor import (
 )
 from lighthouse_tpu.common import metrics as m
 from lighthouse_tpu.common.slot_clock import SlotClock
+from lighthouse_tpu.observability import trace
 
 from .router import CostModelRouter, _next_pow2
 
 # Batchable kinds in strict priority order (the manager's pop order).
 BATCH_KINDS = tuple(k for k in PRIORITY if k in BATCHABLE)
 
+# Deadline margins run negative (a miss overran its budget), so the
+# buckets must span zero — the default ms ladder can't express a miss.
+MARGIN_BUCKETS = (-2.0, -1.0, -0.5, -0.2, -0.1, -0.05, 0.0, 0.05, 0.1,
+                  0.2, 0.5, 1.0, 2.0, 5.0)
+
 
 @dataclass
 class VerifyJob:
     """One queued verification: a SignatureSet plus where its verdict
-    goes. `kind` keys priority + queue caps (must be a BATCHABLE kind)."""
+    goes. `kind` keys priority + queue caps (must be a BATCHABLE kind).
+    `t_arrival` anchors the batch-lifecycle clock: it defaults to
+    construction time, and gossip-side callers override it with the
+    message's arrival stamp so accumulation waits include handoff."""
 
     kind: str
     sset: object
     on_result: Optional[Callable[[bool], None]] = None
+    t_arrival: float = field(default_factory=time.perf_counter)
 
 
 @dataclass
@@ -114,10 +124,17 @@ class ContinuousBatchScheduler:
             "serving_scheduler_close_total",
             "Batch close causes (bucket_full|deadline|flush)", "cause")
         self._m_size = reg.histogram(
-            "serving_scheduler_batch_size",
-            "Dispatched batch sizes",
+            "serving_scheduler_batch_size_sets",
+            "Dispatched batch sizes (signature sets per batch)",
             buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048,
                      4096, 8192, 16384))
+        self._m_margin = reg.histogram(
+            "serving_deadline_margin_seconds",
+            "Slot-third budget minus measured batch latency at dispatch "
+            "(negative = deadline miss)", buckets=MARGIN_BUCKETS)
+        self._m_accum = reg.histogram(
+            "serving_batch_accumulation_seconds",
+            "Per-job wait from arrival to batch dispatch")
 
     # ---------------------------------------------------------------- intake
 
@@ -191,6 +208,8 @@ class ContinuousBatchScheduler:
         if not jobs:
             return False
         self._m_close.labels(cause).inc()
+        trace.instant("batch:close", cat="lifecycle", cause=cause,
+                      n_jobs=len(jobs))
         self._dispatch(jobs)
         return True
 
@@ -198,7 +217,12 @@ class ContinuousBatchScheduler:
         sets = [j.sset for j in jobs]
         budget = self.deadline_budget()
         t0 = time.perf_counter()
-        ok, route = self.router.verify(sets, deadline_budget=budget)
+        # Lifecycle: arrival -> accumulation ends here, execution begins.
+        for j in jobs:
+            self._m_accum.observe(max(t0 - j.t_arrival, 0.0))
+        with trace.span("batch:execute", cat="lifecycle",
+                        n_sets=len(jobs), budget_s=round(budget, 4)):
+            ok, route = self.router.verify(sets, deadline_budget=budget)
         dt = time.perf_counter() - t0
 
         self.stats.batches += 1
@@ -206,6 +230,10 @@ class ContinuousBatchScheduler:
         self.stats.by_route[route] = self.stats.by_route.get(route, 0) + 1
         self._m_batches.inc()
         self._m_size.observe(len(jobs))
+        self._m_margin.observe(budget - dt)
+        trace.instant("batch:verdict", cat="lifecycle", ok=bool(ok),
+                      route=route, n_sets=len(jobs),
+                      margin_s=round(budget - dt, 4))
         if dt <= budget:
             self.stats.deadline_hits += 1
             self._m_hits.inc()

@@ -1,0 +1,123 @@
+"""Metric-name linter: the registry contract, enforced in tier-1.
+
+Walks `lighthouse_tpu/` and `scripts/` for registry registrations
+(`.counter(`, `.counter_vec(`, `.gauge(`, `.gauge_vec(`,
+`.histogram(`, `.histogram_vec(` with a string-literal name) and
+asserts, per metric name:
+
+  1. REGISTERED ONCE — exactly one call site passes a non-empty help
+     string. Help-less calls are lookups (the registry's _get_or_make
+     makes that a supported idiom: probe scripts read counters they
+     didn't create) and may repeat freely.
+  2. snake_case — `[a-z][a-z0-9_]*`.
+  3. UNIT SUFFIX — `_seconds`, `_total`, or `_bytes`; gauges and size
+     histograms may instead use a documented dimensionless unit:
+     `_depth` (queue entries), `_live` (live tasks), `_sets`
+     (signature sets). Anything else is a lint error, because a
+     suffix-less name on /metrics can't be read without grepping the
+     source for its unit.
+
+f-string names (`f"serving_router_{route}_verify_seconds"`) are checked
+with each `{...}` placeholder collapsed to `x` — the static prefix and
+suffix still must conform.
+
+Exit code 0 clean, 1 with findings (tests/test_lint_metrics.py wires
+this into tier-1).
+"""
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+UNIT_SUFFIXES = ("_seconds", "_total", "_bytes")
+DIMENSIONLESS_SUFFIXES = ("_depth", "_live", "_sets")
+SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+# A registration/lookup: method call with a (possibly f-) string-literal
+# first argument, optionally followed by a second string literal (help).
+CALL = re.compile(
+    r"""\.(?:counter|gauge|histogram|counter_vec|gauge_vec|histogram_vec)
+        \(\s*
+        (?P<f>f?)(?P<q>["'])(?P<name>[^"'\n]+)(?P=q)
+        \s*(?P<rest>,|\))""",
+    re.VERBOSE,
+)
+# Does a non-empty help string follow the name? (Only sniffed when the
+# name is followed by a comma; multi-line help starts on the same line.)
+HELP_AFTER = re.compile(r"""^\s*f?(?P<q>["'])(?P<help>[^"'\n]*)""")
+
+
+def walk_sources():
+    for root in ("lighthouse_tpu", "scripts"):
+        for dirpath, _dirnames, filenames in os.walk(
+                os.path.join(REPO, root)):
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def scan_file(path):
+    """Yield (lineno, name, has_help) for each registry call."""
+    text = open(path).read()
+    for match in CALL.finditer(text):
+        name = match.group("name")
+        if match.group("f"):
+            name = re.sub(r"\{[^}]*\}", "x", name)
+        has_help = False
+        if match.group("rest") == ",":
+            tail = text[match.end():match.end() + 200]
+            h = HELP_AFTER.match(tail)
+            has_help = bool(h and h.group("help").strip())
+        lineno = text.count("\n", 0, match.start()) + 1
+        yield lineno, name, has_help
+
+
+def lint():
+    findings = []
+    registrations = {}  # name -> [(path, lineno)]
+    seen = {}           # name -> first site (for the name-shape rules)
+    for path in walk_sources():
+        rel = os.path.relpath(path, REPO)
+        for lineno, name, has_help in scan_file(path):
+            seen.setdefault(name, (rel, lineno))
+            if has_help:
+                registrations.setdefault(name, []).append((rel, lineno))
+
+    for name, (rel, lineno) in sorted(seen.items()):
+        where = f"{rel}:{lineno}"
+        if not SNAKE.match(name):
+            findings.append(f"{where}: metric {name!r} is not snake_case")
+        if not name.endswith(UNIT_SUFFIXES + DIMENSIONLESS_SUFFIXES):
+            findings.append(
+                f"{where}: metric {name!r} lacks a unit suffix "
+                f"({'|'.join(UNIT_SUFFIXES)}, or dimensionless "
+                f"{'|'.join(DIMENSIONLESS_SUFFIXES)})")
+        sites = registrations.get(name, [])
+        if len(sites) == 0:
+            findings.append(
+                f"{where}: metric {name!r} is only ever looked up — no "
+                "call site passes help text (register it once, with help)")
+        elif len(sites) > 1:
+            locs = ", ".join(f"{r}:{n}" for r, n in sites)
+            findings.append(
+                f"metric {name!r} registered with help at {len(sites)} "
+                f"sites ({locs}) — register once, look up elsewhere")
+    return findings, sorted(seen)
+
+
+def main():
+    findings, names = lint()
+    if findings:
+        print(f"lint_metrics: {len(findings)} finding(s) over "
+              f"{len(names)} metric name(s)\n")
+        for f in findings:
+            print(f"  {f}")
+        return 1
+    print(f"lint_metrics: OK ({len(names)} metric names)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -41,6 +41,7 @@ def micro(sizes):
     """Dependency-chained micro A/B: each timed call feeds the previous
     output back in (values keep evolving, so the tunnel cannot serve a
     cached execution) and forces a full fetch at the end."""
+    out = []
     import jax
     import jax.numpy as jnp
 
@@ -79,6 +80,8 @@ def micro(sizes):
                  jax.jit(lambda x: _chain(btw.fp2_mul, x, CHAIN)), a_bm,
                  n * CHAIN)
         print(f"  fp2_mul speedup: {t1 / t2:.2f}x")
+        out.append({"op": "fp2_mul", "n": n, "major_s": t1, "bm_s": t2,
+                    "speedup": t1 / t2})
 
         d12 = rng.integers(0, 256, size=(n, 2, 3, 2, lb.L)).astype(np.float32)
         f_maj = jnp.asarray(d12)
@@ -90,6 +93,9 @@ def micro(sizes):
                  jax.jit(lambda x: _chain1(btw.fp12_sqr, x, CHAIN)), f_bm,
                  n * CHAIN)
         print(f"  fp12_sqr speedup: {t1 / t2:.2f}x")
+        out.append({"op": "fp12_sqr", "n": n, "major_s": t1, "bm_s": t2,
+                    "speedup": t1 / t2})
+    return out
 
 
 def _chain(op, x, k):
@@ -114,6 +120,7 @@ def stages(sizes):
     from lighthouse_tpu.ops.bm import backend as bmb
     from lighthouse_tpu.ops.bm import curves as bmc
 
+    out = []
     k = 4
     for n in sizes:
         print(f"stages n={n} k={k}")
@@ -142,6 +149,10 @@ def stages(sizes):
         t_bm = _timed(lambda: bool(core_bm(*args_bm)))
         print(f"  bm    total: {t_bm:.3f}s -> {n / t_bm:8.1f} sigs/s "
               f"({t_maj / t_bm:.2f}x)")
+        out.append({"n": n, "k": k, "major_s": t_maj, "bm_s": t_bm,
+                    "major_sigs_s": n / t_maj, "bm_sigs_s": n / t_bm,
+                    "speedup": t_maj / t_bm})
+    return out
 
 
 def chunk(sizes):
@@ -156,6 +167,7 @@ def chunk(sizes):
     from lighthouse_tpu.ops.bm import curves as bmc
     from lighthouse_tpu.ops.bm import limbs as lb
 
+    out = []
     k = 4
     for n in sizes:
         width = bmb.prep_chunk_width(n)
@@ -189,6 +201,9 @@ def chunk(sizes):
                       f"{str(e)[:80]})")
         if len(times) == 2:
             print(f"  chunked speedup: {times[0] / times[width]:.2f}x")
+        out.append({"n": n, "k": k, "width": width,
+                    "total_s": {str(w): t for w, t in times.items()}})
+    return out
 
 
 def e2e(sizes):
@@ -197,6 +212,7 @@ def e2e(sizes):
     from lighthouse_tpu.ops import backend as be
     import __graft_entry__ as ge
 
+    out = []
     os.environ["LIGHTHOUSE_TPU_CPU_FALLBACK_MAX"] = "0"
     for n in sizes:
         base = ge._example_sets(64, keys_per_set=4)
@@ -206,6 +222,7 @@ def e2e(sizes):
             ok = be.verify_signature_sets_tpu(sets, sharded=False)
             if not ok:
                 print(f"  e2e n={n} {layout}: FAILED VERIFY")
+                out.append({"n": n, "layout": layout, "ok": False})
                 continue
             iters = 0
             pending = []
@@ -221,21 +238,31 @@ def e2e(sizes):
             dt = time.perf_counter() - t0
             print(f"  e2e n={n} {layout}: {n * iters / dt:8.1f} sigs/s "
                   f"({iters} iters)")
+            out.append({"n": n, "layout": layout, "ok": True,
+                        "iters": iters, "sigs_s": n * iters / dt})
+    return out
 
 
 def main():
+    from lighthouse_tpu.observability import report
+
     mode = sys.argv[1] if len(sys.argv) > 1 else "all"
     sizes = [int(a) for a in sys.argv[2:]] or [1024]
     import jax
     print(f"devices: {jax.devices()}", file=sys.stderr)
+    rep = report.make("probe_bm", params={"mode": mode, "sizes": sizes})
+    results = {}
     if mode in ("micro", "all"):
-        micro(sizes)
+        results["micro"] = micro(sizes)
     if mode in ("stages", "all"):
-        stages(sizes)
+        results["stages"] = stages(sizes)
     if mode == "chunk":
-        chunk(sizes)
+        results["chunk"] = chunk(sizes)
     if mode in ("e2e", "all"):
-        e2e(sizes)
+        results["e2e"] = e2e(sizes)
+    ok = all(row.get("ok", True)
+             for rows in results.values() for row in rows)
+    report.emit(report.finish(rep, ok=ok, results=results))
 
 
 if __name__ == "__main__":

@@ -80,6 +80,19 @@ def main():
         f"({per_att*1e3:.2f} ms/att) vs slot third {third:.1f}s"
     )
 
+    from lighthouse_tpu.observability import report as obs_report
+
+    rep = obs_report.make("probe_firehose_tpu", params={
+        "n_extra": n_extra, "per_committee": per_committee,
+        "max_bucket": max_bucket})
+    obs_report.emit(obs_report.finish(
+        rep, ok=stats["imported"] == stats["n_atts"], results={
+            **stats,
+            "sign_secs": round(sign_secs, 2),
+            "shuffle_secs": round(shuffle_secs, 2),
+            "slot_third_s": third,
+            "ms_per_att": round(per_att * 1e3, 3)}))
+
 
 if __name__ == "__main__":
     main()

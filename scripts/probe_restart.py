@@ -26,7 +26,6 @@ minutes; don't run concurrently with other compile jobs on small hosts.
 """
 
 import argparse
-import json
 import os
 import subprocess
 import sys
@@ -45,8 +44,14 @@ sys.path.insert(0, REPO)
 
 def consumer(n: int, k: int) -> int:
     """Measure start-to-first-full-size-verified-batch in THIS process.
-    Emits one JSON line on stdout; everything else goes to stderr."""
+    Emits one probe-report JSON line (observability/report.py schema) on
+    stdout; everything else goes to stderr."""
     os.environ["LIGHTHOUSE_TPU_CPU_FALLBACK_MAX"] = "0"  # measure device
+
+    from lighthouse_tpu.observability import report as obs_report
+
+    rep = obs_report.make("probe_restart.consumer",
+                          params={"n": n, "k": k})
 
     from lighthouse_tpu.beacon_processor.processor import AdaptiveBatchPolicy
     from lighthouse_tpu.beacon_processor.warming import ShapeWarmer
@@ -127,8 +132,9 @@ def consumer(n: int, k: int) -> int:
             "latency_table": router.table.snapshot(),
         },
     }
-    print(json.dumps(out))
-    return 0 if (results and all(results)) else 1
+    ok = bool(results) and all(results)
+    obs_report.emit(obs_report.finish(rep, ok=ok, results=out))
+    return 0 if ok else 1
 
 
 # ---------------------------------------------------------------------------
@@ -145,12 +151,14 @@ def _spawn_consumer(n, k, env_extra):
          "--consumer", f"--n={n}", f"--k={k}"],
         env=env, cwd=REPO, capture_output=True, text=True)
     sys.stderr.write(proc.stderr)
-    for line in reversed(proc.stdout.splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            return json.loads(line)
-    raise RuntimeError(f"consumer emitted no JSON (rc={proc.returncode}):\n"
-                       f"{proc.stdout[-2000:]}")
+    from lighthouse_tpu.observability import report as obs_report
+
+    docs = obs_report.parse_lines(proc.stdout)
+    if docs:
+        return docs[-1]["results"]
+    raise RuntimeError(
+        f"consumer emitted no probe report (rc={proc.returncode}):\n"
+        f"{proc.stdout[-2000:]}")
 
 
 def main(argv=None):
@@ -239,6 +247,14 @@ def main(argv=None):
     if not warm["bundle_warmed"]:
         print("WARNING: warm consumer fell back to the compile path "
               "(stale/missing bundle?)")
+    from lighthouse_tpu.observability import report as obs_report
+
+    rep = obs_report.make("probe_restart", params={
+        "n": args.n, "k": args.k, "bundle": args.bundle,
+        "cold": bool(args.cold)})
+    obs_report.emit(obs_report.finish(rep, ok=ok, results={
+        "warm": warm, "cold": cold,
+        "export_secs": round(export_secs, 2)}))
     return 0 if ok else 1
 
 

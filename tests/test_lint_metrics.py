@@ -1,0 +1,48 @@
+"""Tier-1 wiring for scripts/lint_metrics.py (ISSUE 13 satellite): the
+metric-name contract — registered once with help, snake_case, unit
+suffix — holds over the whole tree on every test run."""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "lint_metrics", os.path.join(REPO, "scripts", "lint_metrics.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_metric_names_conform():
+    lm = _load()
+    findings, names = lm.lint()
+    assert findings == [], "\n".join(findings)
+    # The tree registers a meaningful number of metrics; an empty scan
+    # means the walker broke, not that the code went metric-free.
+    assert len(names) >= 25
+
+
+def test_linter_catches_bad_names(tmp_path, monkeypatch):
+    """The linter actually fires on each rule (guards against the scan
+    regexes rotting into match-nothing)."""
+    lm = _load()
+    bad = tmp_path / "lighthouse_tpu" / "bad.py"
+    bad.parent.mkdir()
+    bad.write_text(
+        'reg.counter("CamelCase_total", "help a")\n'
+        'reg.counter("no_unit_suffix", "help b")\n'
+        'reg.counter("dup_total", "help c")\n'
+        'reg.counter("dup_total", "help d")\n'
+        'reg.counter("orphan_total")\n')
+    (tmp_path / "scripts").mkdir()
+    monkeypatch.setattr(lm, "REPO", str(tmp_path))
+    findings, names = lm.lint()
+    assert len(names) == 4
+    joined = "\n".join(findings)
+    assert "not snake_case" in joined
+    assert "lacks a unit suffix" in joined
+    assert "2 sites" in joined
+    assert "only ever looked up" in joined
