@@ -130,6 +130,15 @@ class AdaptiveBatchPolicy:
                 self.warm.add(bucket)
                 self._warm_max = max(self._warm_max, bucket)
 
+    def set_max_bucket(self, n: int) -> int:
+        """Re-pin the bucket-menu ceiling (the autotuner's bucket_menu
+        knob, or a restored policy). Floored to a power of two, never
+        below 2 — the grid only holds pow2 shapes and a 1-cap would
+        disable batching entirely. Returns the value installed."""
+        n = max(2, int(n))
+        self._max_bucket = 1 << (n.bit_length() - 1)
+        return self._max_bucket
+
 
 @dataclass
 class WorkEvent:

@@ -47,6 +47,32 @@ DEFAULT_SHAPE_GRID: Tuple[Tuple[int, int], ...] = (
 )
 
 
+def tuned_shape_grid(policy: Optional[dict],
+                     default: Sequence[Tuple[int, int]] = DEFAULT_SHAPE_GRID,
+                     ) -> Tuple[Tuple[int, int], ...]:
+    """The warming grid a persisted autotune policy asks for (the
+    `warm_grid` facet of serving/autotune's TunedPolicy dict), or
+    `default` when the policy is absent/malformed — a restarted node
+    warms exactly the shapes its own traffic proved it needs instead of
+    the full static grid."""
+    if not isinstance(policy, dict):
+        return tuple(default)
+    grid = policy.get("warm_grid")
+    if not isinstance(grid, (list, tuple)) or not grid:
+        return tuple(default)
+    out = []
+    for pair in grid:
+        try:
+            n, k = pair
+            n, k = int(n), int(k)
+        except (TypeError, ValueError):
+            return tuple(default)
+        if n < 2 or k < 1:
+            return tuple(default)
+        out.append((n, k))
+    return tuple(out)
+
+
 class ShapeWarmer:
     def __init__(
         self,

@@ -371,6 +371,12 @@ class Registry:
             metrics = list(self._metrics.values())
         return "".join(m.expose() for m in metrics)
 
+    def families(self) -> Dict[str, object]:
+        """Snapshot of name -> metric object (for programmatic readers
+        like observability/timeseries; later registrations don't appear)."""
+        with self._lock:
+            return dict(self._metrics)
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._metrics)

@@ -15,6 +15,10 @@ Four cooperating pieces:
                        persistent-cache hit vs warm-bundle hit) plus
                        jax-internal monitoring hooks.
   * `report`         — the one probe-script JSON envelope.
+  * `timeseries`     — in-process ring buffer of registry snapshots;
+                       windowed delta/rate/quantile queries.
+  * `slo`            — declarative objectives evaluated over those
+                       windows (`slo_status{objective}`).
 
 Everything degrades to no-ops rather than raising: instrumentation must
 never be the thing that takes the batch path down.
@@ -25,11 +29,13 @@ consult this package from inside builders, and an eager import of
 through `lighthouse_tpu` package init.
 """
 
-_SUBMODULES = ("trace", "stages", "compile_events", "report")
+_SUBMODULES = ("trace", "stages", "compile_events", "report",
+               "timeseries", "slo")
 
 __all__ = [
-    "trace", "stages", "compile_events", "report",
+    "trace", "stages", "compile_events", "report", "timeseries", "slo",
     "Tracer", "TRACER", "span", "instant", "enable", "disable",
+    "TimeSeries", "SloEngine", "Objective", "serving_objectives",
 ]
 
 _EXPORTS = {
@@ -39,6 +45,10 @@ _EXPORTS = {
     "instant": ("trace", "instant"),
     "enable": ("trace", "enable"),
     "disable": ("trace", "disable"),
+    "TimeSeries": ("timeseries", "TimeSeries"),
+    "SloEngine": ("slo", "SloEngine"),
+    "Objective": ("slo", "Objective"),
+    "serving_objectives": ("slo", "serving_objectives"),
 }
 
 

@@ -14,18 +14,22 @@ Three cooperating layers:
   * `router`    — a measured-latency table routing small or
                   deadline-critical batches to the native CPU backend
                   while bulk traffic rides the device engine.
+  * `autotune`  — the online control loop re-picking the scheduler /
+                  router / bucket-menu knobs from windowed metric
+                  evidence, persisted into the bundle manifest.
 
 Submodules import lazily (PEP 562): `ops.backend` consults `aot` from
 inside its jit builders, and an eager package import would cycle.
 """
 
-_SUBMODULES = ("aot", "router", "scheduler")
+_SUBMODULES = ("aot", "router", "scheduler", "autotune")
 
 __all__ = [
-    "aot", "router", "scheduler",
+    "aot", "router", "scheduler", "autotune",
     "ContinuousBatchScheduler", "VerifyJob",
     "CostModelRouter", "LatencyTable",
     "WarmBundle", "make_bundle", "open_bundle",
+    "Autotuner", "apply_policy",
 ]
 
 _EXPORTS = {
@@ -36,6 +40,8 @@ _EXPORTS = {
     "WarmBundle": ("aot", "WarmBundle"),
     "make_bundle": ("aot", "make_bundle"),
     "open_bundle": ("aot", "open_bundle"),
+    "Autotuner": ("autotune", "Autotuner"),
+    "apply_policy": ("autotune", "apply_policy"),
 }
 
 

@@ -250,6 +250,13 @@ class WarmBundle:
     def entries(self) -> Dict[str, dict]:
         return self.manifest.get("entries", {})
 
+    @property
+    def policy(self) -> Optional[dict]:
+        """The persisted autotune policy, if one was saved (see
+        `save_policy` / serving/autotune.apply_policy)."""
+        p = self.manifest.get("policy")
+        return p if isinstance(p, dict) else None
+
     def has_stage(self, key: str) -> bool:
         return key in self.manifest.get("stages", {})
 
@@ -503,8 +510,12 @@ def make_bundle(path: str, shapes: Sequence[Tuple[int, int]],
     report = ExportReport()
 
     old = None
+    policy = None
     try:
         old = json.loads(open(os.path.join(path, MANIFEST_NAME), "rb").read())
+        # The autotune policy is measured fact, not compiled code: it
+        # survives even a stale rebuild that discards every artifact.
+        policy = old.get("policy")
         if (old.get("bundle_version") != BUNDLE_VERSION
                 or old.get("jax_version") != jax.__version__
                 or old.get("platform") != jax.default_backend()):
@@ -573,12 +584,54 @@ def make_bundle(path: str, shapes: Sequence[Tuple[int, int]],
         "entries": entries,
         "stages": stage_files,
     }
+    if isinstance(policy, dict):
+        manifest["policy"] = policy
+    _write_manifest(path, manifest)
+    return report
+
+
+def _write_manifest(path: str, manifest: dict) -> None:
     mpath = os.path.join(path, MANIFEST_NAME)
     tmp = mpath + f".tmp{os.getpid()}"
     with open(tmp, "w") as f:
         json.dump(manifest, f, indent=1, sort_keys=True)
     os.replace(tmp, mpath)
-    return report
+
+
+# ---------------------------------------------------------------------------
+# Autotune policy persistence (serving/autotune.py round-trips through here)
+# ---------------------------------------------------------------------------
+
+
+def save_policy(path: str, policy: dict) -> None:
+    """Store an autotune policy under `manifest["policy"]`, preserving any
+    existing bundle entries/stages (the producer and the autotuner share
+    one manifest). Writes a skeleton manifest when none exists yet — a
+    node can persist its learned policy before it ever exports a stage.
+    No jax import: the serving control plane stays jax-free."""
+    os.makedirs(path, exist_ok=True)
+    try:
+        manifest = json.loads(
+            open(os.path.join(path, MANIFEST_NAME), "rb").read())
+    except (OSError, ValueError):
+        manifest = {"bundle_version": BUNDLE_VERSION,
+                    "entries": {}, "stages": {}}
+    manifest["policy"] = dict(policy)
+    _write_manifest(path, manifest)
+
+
+def load_policy(path: str) -> Optional[dict]:
+    """Read back a persisted policy, or None. Deliberately NOT staleness-
+    gated the way `open_bundle` is: the policy is measured fact about
+    traffic and hardware, not compiled code — a jax upgrade invalidates
+    the artifacts, not the measurements."""
+    try:
+        manifest = json.loads(
+            open(os.path.join(path, MANIFEST_NAME), "rb").read())
+    except (OSError, ValueError):
+        return None
+    policy = manifest.get("policy")
+    return policy if isinstance(policy, dict) else None
 
 
 def _current_layout() -> str:

@@ -126,6 +126,34 @@ class CostModelRouter:
             "serving_router_fallback_total",
             "Device-route failures retried on the native CPU route",
             "outcome")
+        self._restored = reg.counter(
+            "serving_router_table_restored_total",
+            "EWMA latency-table entries restored from a persisted "
+            "autotune policy at startup")
+
+    # ------------------------------------------------------------ restore
+
+    def restore_table(self, entries) -> int:
+        """Seed the latency table from a persisted policy's
+        `table.snapshot()` dict (`"route:bucket" -> seconds`). Seeds only
+        — live EWMA traffic still overrides them. Returns the number of
+        entries installed (malformed keys/values are skipped, not fatal:
+        a half-readable policy is still better than a cold table)."""
+        installed = 0
+        for key, secs in (entries or {}).items():
+            try:
+                route, bucket = str(key).rsplit(":", 1)
+                secs = float(secs)
+                bucket = int(bucket)
+            except (ValueError, TypeError):
+                continue
+            if route not in ("cpu", "device") or bucket < 1 or secs < 0:
+                continue
+            self.table.seed(route, bucket, secs)
+            installed += 1
+        if installed:
+            self._restored.inc(installed)
+        return installed
 
     # -------------------------------------------------------------- routing
 

@@ -135,6 +135,15 @@ class ContinuousBatchScheduler:
         self._m_accum = reg.histogram(
             "serving_batch_accumulation_seconds",
             "Per-job wait from arrival to batch dispatch")
+        self._m_batch_lat = reg.histogram(
+            "serving_scheduler_batch_seconds",
+            "Measured batch wall time from close to verdict (what the "
+            "p50-latency SLO and the autotuner read)")
+        self._m_distinct = reg.histogram(
+            "serving_batch_distinct_messages_sets",
+            "Distinct messages per dispatched batch (drives the "
+            "autotuned M_BUCKET_SHIFTS menu)",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
 
     # ---------------------------------------------------------------- intake
 
@@ -231,6 +240,11 @@ class ContinuousBatchScheduler:
         self._m_batches.inc()
         self._m_size.observe(len(jobs))
         self._m_margin.observe(budget - dt)
+        self._m_batch_lat.observe(dt)
+        msgs = {getattr(j.sset, "message", None) for j in jobs}
+        msgs.discard(None)
+        if msgs:
+            self._m_distinct.observe(len(msgs))
         trace.instant("batch:verdict", cat="lifecycle", ok=bool(ok),
                       route=route, n_sets=len(jobs),
                       margin_s=round(budget - dt, 4))

@@ -13,9 +13,15 @@ asserts, per metric name:
   3. UNIT SUFFIX — `_seconds`, `_total`, or `_bytes`; gauges and size
      histograms may instead use a documented dimensionless unit:
      `_depth` (queue entries), `_live` (live tasks), `_sets`
-     (signature sets). Anything else is a lint error, because a
-     suffix-less name on /metrics can't be read without grepping the
-     source for its unit.
+     (signature sets), `_status` (0/1 objective status). Anything else
+     is a lint error, because a suffix-less name on /metrics can't be
+     read without grepping the source for its unit.
+  4. BOUNDED LABELS — every label NAME declared at a `*_vec`
+     registration site must come from ALLOWED_LABEL_NAMES, the
+     documented closed vocabularies (route, cause, knob, ...). A label
+     like `peer_id` or `slot` explodes series cardinality on /metrics;
+     adding a genuinely new bounded dimension means extending the
+     allow-list here, which is the review hook.
 
 f-string names (`f"serving_router_{route}_verify_seconds"`) are checked
 with each `{...}` placeholder collapsed to `x` — the static prefix and
@@ -32,13 +38,23 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 UNIT_SUFFIXES = ("_seconds", "_total", "_bytes")
-DIMENSIONLESS_SUFFIXES = ("_depth", "_live", "_sets")
+DIMENSIONLESS_SUFFIXES = ("_depth", "_live", "_sets", "_status")
 SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+# Every label name in use, each a closed vocabulary (the help text at
+# the registration site enumerates the values). Bounded by construction:
+# a new name lands here via review, not via a production cardinality
+# incident.
+ALLOWED_LABEL_NAMES = frozenset((
+    "cause", "engine", "event", "kernel", "kind", "knob", "objective",
+    "outcome", "reason", "route", "stage",
+))
 
 # A registration/lookup: method call with a (possibly f-) string-literal
 # first argument, optionally followed by a second string literal (help).
 CALL = re.compile(
-    r"""\.(?:counter|gauge|histogram|counter_vec|gauge_vec|histogram_vec)
+    r"""\.(?:counter|gauge|histogram|(?P<vec>counter_vec|gauge_vec
+        |histogram_vec))
         \(\s*
         (?P<f>f?)(?P<q>["'])(?P<name>[^"'\n]+)(?P=q)
         \s*(?P<rest>,|\))""",
@@ -47,6 +63,7 @@ CALL = re.compile(
 # Does a non-empty help string follow the name? (Only sniffed when the
 # name is followed by a comma; multi-line help starts on the same line.)
 HELP_AFTER = re.compile(r"""^\s*f?(?P<q>["'])(?P<help>[^"'\n]*)""")
+STR_LIT = re.compile(r"""(["'])([^"'\n]*)\1""")
 
 
 def walk_sources():
@@ -58,8 +75,75 @@ def walk_sources():
                     yield os.path.join(dirpath, fn)
 
 
+def _call_args(text, open_idx):
+    """The argument source of the call whose '(' sits at open_idx
+    (bracket-balanced, string-aware — help strings contain parens)."""
+    depth, i, q = 0, open_idx, None
+    while i < len(text):
+        ch = text[i]
+        if q:
+            if ch == "\\":
+                i += 1
+            elif ch == q:
+                q = None
+        elif ch in "\"'":
+            q = ch
+        elif ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+            if depth == 0:
+                return text[open_idx + 1:i]
+        i += 1
+    return text[open_idx + 1:]
+
+
+def _split_top(argsrc):
+    """Split an argument source on top-level commas only."""
+    parts, buf, depth, q = [], [], 0, None
+    for ch in argsrc:
+        if q:
+            buf.append(ch)
+            if ch == q:
+                q = None
+            continue
+        if ch in "\"'":
+            q = ch
+        elif ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            parts.append("".join(buf))
+            buf = []
+            continue
+        buf.append(ch)
+    parts.append("".join(buf))
+    return parts
+
+
+def _label_names(argsrc):
+    """Label NAMES declared at a *_vec registration: the `labels=(...)`
+    kwarg when present, else the positional string literals after
+    (name, help). Adjacent-string help concatenation parses as one
+    top-level part, so a multi-line help never masquerades as a label."""
+    m = re.search(r"labels\s*=\s*\(([^)]*)\)", argsrc)
+    if m:
+        return [s.group(2) for s in STR_LIT.finditer(m.group(1))]
+    out = []
+    for part in _split_top(argsrc)[2:]:
+        s = part.strip()
+        lit = STR_LIT.fullmatch(s)
+        if lit:
+            out.append(lit.group(2))
+        elif "=" in s:
+            break
+    return out
+
+
 def scan_file(path):
-    """Yield (lineno, name, has_help) for each registry call."""
+    """Yield (lineno, name, has_help, labels) for each registry call;
+    labels is None for non-vec methods, else the declared label names."""
     text = open(path).read()
     for match in CALL.finditer(text):
         name = match.group("name")
@@ -70,8 +154,12 @@ def scan_file(path):
             tail = text[match.end():match.end() + 200]
             h = HELP_AFTER.match(tail)
             has_help = bool(h and h.group("help").strip())
+        labels = None
+        if match.group("vec"):
+            open_idx = text.index("(", match.start())
+            labels = _label_names(_call_args(text, open_idx))
         lineno = text.count("\n", 0, match.start()) + 1
-        yield lineno, name, has_help
+        yield lineno, name, has_help, labels
 
 
 def lint():
@@ -80,10 +168,18 @@ def lint():
     seen = {}           # name -> first site (for the name-shape rules)
     for path in walk_sources():
         rel = os.path.relpath(path, REPO)
-        for lineno, name, has_help in scan_file(path):
+        for lineno, name, has_help, labels in scan_file(path):
             seen.setdefault(name, (rel, lineno))
             if has_help:
                 registrations.setdefault(name, []).append((rel, lineno))
+                for label in (labels or ()):
+                    if label not in ALLOWED_LABEL_NAMES:
+                        findings.append(
+                            f"{rel}:{lineno}: metric {name!r} declares "
+                            f"unbounded label {label!r} — label names must "
+                            "come from ALLOWED_LABEL_NAMES (closed "
+                            "vocabularies only; extend the allow-list to "
+                            "add a bounded dimension)")
 
     for name, (rel, lineno) in sorted(seen.items()):
         where = f"{rel}:{lineno}"

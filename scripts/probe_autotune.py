@@ -1,0 +1,314 @@
+#!/usr/bin/env python
+"""Shifting-traffic probe: autotuned vs static serving config.
+
+The acceptance run for the observability control loop (ISSUE 17): the
+SAME scripted traffic mix — an aggregate trickle under deadline
+pressure, then a distinct-message-heavy burst phase, then a small-batch
+trickle — is driven twice through the continuous scheduler + cost
+router on a manual slot clock:
+
+  * **static**  — `LIGHTHOUSE_TPU_AUTOTUNE=0`: the autotuner is
+    constructed but the kill switch makes every step a no-op, so the
+    run is bit-identical to a build without the autotuner (that's the
+    acceptance claim, and the overhead of a disabled step is measured
+    and reported);
+  * **autotuned** — the `serving/autotune.Autotuner` samples the metric
+    time-series after every round, judges the serving SLOs, and re-picks
+    the knobs; its decisions, the SLO snapshot, and the persisted policy
+    round-trip are all in the report.
+
+Synthetic backends model the real failure modes with deterministic
+`time.sleep` latencies: the host route stalls periodically (GC-pause
+analog), the device route pays a one-time cold-compile penalty per new
+pow2 bucket plus a flat warm dispatch. The static config misses
+deadlines on the stalls (it closes batches with only `close_margin_s`
+of headroom); the autotuned config widens the accumulation margin after
+the first miss and re-pins the router cutoff to the measured crossover,
+so stalls land inside the budget and small batches take the cheaper
+route — which is exactly what the report must show:
+
+    autotuned deadline-hit rate >= static, p50 batch latency <= static
+
+Everything is measured from the exported metrics themselves (the
+time-series quantile over `serving_scheduler_batch_seconds`, the
+hit/miss counters) and emitted through the shared probe-report schema
+(`observability/report.py`) as one JSON line.
+
+CPU-runnable, no jax needed:
+
+    python scripts/probe_autotune.py
+    python scripts/probe_autotune.py --quick --json
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Deterministic synthetic latency model (seconds).
+CPU_PER_SET = 0.002        # native verify: ~linear in sets
+CPU_STALL_EXTRA = 0.100    # every STALL_EVERY-th host call stalls
+CPU_STALL_EVERY = 4
+DEV_WARM = 0.006           # compile-amortized device dispatch
+DEV_COLD_EXTRA = 0.150     # first time a pow2 bucket is seen
+
+
+class _Backends:
+    """Per-config backend pair with private cold/stall state."""
+
+    def __init__(self, tag):
+        from lighthouse_tpu.crypto.bls import api
+
+        self.cpu_name = f"_probe_at_cpu_{tag}"
+        self.dev_name = f"_probe_at_dev_{tag}"
+        self._cpu_calls = 0
+        self._cold_seen = set()
+
+        def cpu(sets):
+            self._cpu_calls += 1
+            dt = CPU_PER_SET * len(sets)
+            if self._cpu_calls % CPU_STALL_EVERY == 0:
+                dt += CPU_STALL_EXTRA
+            time.sleep(dt)
+            return True
+
+        def dev(sets):
+            b = 1
+            while b < max(1, len(sets)):
+                b *= 2
+            dt = DEV_WARM
+            if b not in self._cold_seen:
+                self._cold_seen.add(b)
+                dt += DEV_COLD_EXTRA
+            time.sleep(dt)
+            return True
+
+        api.register_backend(self.cpu_name, cpu)
+        api.register_backend(self.dev_name, dev)
+
+
+class _MsgSet:
+    """A signature-set stand-in carrying a message (the scheduler's
+    distinct-message histogram reads `.message`)."""
+
+    def __init__(self, message):
+        self.message = message
+
+
+def run_config(autotuned: bool, rounds_a: int, rounds_b: int,
+               rounds_c: int, bundle_dir=None):
+    from lighthouse_tpu.beacon_processor.processor import AdaptiveBatchPolicy
+    from lighthouse_tpu.common.metrics import Registry
+    from lighthouse_tpu.common.slot_clock import ManualSlotClock
+    from lighthouse_tpu.observability.slo import SloEngine, serving_objectives
+    from lighthouse_tpu.observability.timeseries import TimeSeries
+    from lighthouse_tpu.serving.autotune import Autotuner
+    from lighthouse_tpu.serving.router import CostModelRouter, LatencyTable
+    from lighthouse_tpu.serving.scheduler import (
+        ContinuousBatchScheduler,
+        VerifyJob,
+    )
+
+    tag = "auto" if autotuned else "static"
+    be = _Backends(tag)
+    reg = Registry()
+    router = CostModelRouter(table=LatencyTable(), cpu_backend=be.cpu_name,
+                             device_backend=be.dev_name,
+                             small_batch_max=16, registry=reg)
+    clock = ManualSlotClock(genesis_time=0, seconds_per_slot=12)
+    sched = ContinuousBatchScheduler(
+        clock, policy=AdaptiveBatchPolicy(max_bucket=1024), router=router,
+        close_margin_s=0.050, registry=reg)
+    ts = TimeSeries(reg)
+    slo = SloEngine(ts, serving_objectives(deadline_hit_rate=0.95,
+                                           p50_batch_latency_s=0.05),
+                    window_s=60.0, registry=reg)
+    at = Autotuner(scheduler=sched, router=router,
+                   batch_policy=sched.policy, timeseries=ts, slo=slo,
+                   window_s=60.0, min_batches=2,
+                   margin_bounds=(0.01, 0.6), registry=reg,
+                   enabled=autotuned)   # static: the env kill-switch path
+
+    slot = [100]
+
+    def tick():
+        at.step(now=clock._now_seconds())
+
+    def drive_until_dispatch(max_steps=400):
+        for _ in range(max_steps):
+            if sched.step():
+                return
+            clock.advance_seconds(0.05)
+        sched.step(flush=True)
+
+    # Phase A — aggregate trickle under deadline pressure: singleton
+    # aggregates arriving with ~1s of slot-third budget left. Singletons
+    # accumulate until the deadline rule closes them, so the close
+    # margin is the whole game: too tight and a host stall overruns the
+    # budget the batch closed with.
+    for _ in range(rounds_a):
+        clock.set_slot(slot[0]); slot[0] += 1
+        clock.advance_seconds(3.0)          # 1.0s budget in this third
+        sched.submit(VerifyJob("gossip_aggregate", "agg"))
+        drive_until_dispatch()
+        tick()
+
+    # Phase B — distinct-message-heavy bursts: full 64-set batches of
+    # committee-repeated messages (4 distinct), fresh-third budget.
+    for i in range(rounds_b):
+        clock.set_slot(slot[0]); slot[0] += 1
+        for j in range(64):
+            sched.submit(VerifyJob("gossip_attestation",
+                                   _MsgSet(f"m{j % 4}")))
+        drive_until_dispatch()
+        tick()
+
+    # Phase C — small-batch trickle: 8-set batches, plenty of budget.
+    # The route choice decides the latency: host pays per-set cost and
+    # periodic stalls, device is a flat warm dispatch.
+    for _ in range(rounds_c):
+        clock.set_slot(slot[0]); slot[0] += 1
+        for _ in range(8):
+            sched.submit(VerifyJob("gossip_attestation", "s"))
+        drive_until_dispatch()
+        tick()
+
+    # Measure the acceptance numbers from the exported metrics, not the
+    # Python objects: one final sample, whole-run window.
+    ts.sample(now=clock._now_seconds())
+    p50 = ts.quantile("serving_scheduler_batch_seconds", 0.5, None)
+    batches = sched.stats.batches
+    hits = sched.stats.deadline_hits
+    out = {
+        "batches": batches,
+        "deadline_hits": hits,
+        "deadline_misses": sched.stats.deadline_misses,
+        "hit_rate": round(hits / batches, 4) if batches else None,
+        "p50_batch_seconds": round(p50, 6) if p50 is not None else None,
+        "by_route": dict(sched.stats.by_route),
+        "close_margin_s": round(sched.close_margin_s, 4),
+        "router_cutoff": router.small_batch_max,
+        "slo": slo.snapshot(),
+    }
+    if autotuned:
+        out["decisions"] = [d.as_dict() for d in at.decisions]
+        if bundle_dir:
+            at.save(bundle_dir)
+            out["policy_saved"] = bundle_dir
+    else:
+        # Acceptance: a disabled step must be a no-op cheap enough to
+        # leave on every control tick (reported, not asserted).
+        t0 = time.perf_counter()
+        for _ in range(1000):
+            at.step()
+        out["disabled_step_us"] = round(
+            (time.perf_counter() - t0) * 1e3, 3)
+        out["decisions"] = [d.as_dict() for d in at.decisions]  # == []
+    return out
+
+
+def restored_node_summary(bundle_dir):
+    """The restart story: a fresh stack inherits the persisted policy."""
+    from lighthouse_tpu.beacon_processor.processor import AdaptiveBatchPolicy
+    from lighthouse_tpu.common.metrics import Registry
+    from lighthouse_tpu.common.slot_clock import ManualSlotClock
+    from lighthouse_tpu.serving import aot
+    from lighthouse_tpu.serving.autotune import apply_policy
+    from lighthouse_tpu.serving.router import CostModelRouter, LatencyTable
+    from lighthouse_tpu.serving.scheduler import ContinuousBatchScheduler
+
+    pol = aot.load_policy(bundle_dir)
+    reg = Registry()
+    router = CostModelRouter(table=LatencyTable(), small_batch_max=16,
+                             registry=reg)
+    sched = ContinuousBatchScheduler(
+        ManualSlotClock(genesis_time=0, seconds_per_slot=12),
+        policy=AdaptiveBatchPolicy(max_bucket=1024), router=router,
+        registry=reg)
+    applied = apply_policy(pol, scheduler=sched, router=router,
+                           batch_policy=sched.policy, check_env=False)
+    return {
+        "policy_version": (pol or {}).get("policy_version"),
+        "applied": [d.as_dict() for d in applied],
+        "table_restored": reg.counter(
+            "serving_router_table_restored_total").get(),
+        "close_margin_s": round(sched.close_margin_s, 4),
+        "router_cutoff": router.small_batch_max,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--quick", action="store_true",
+                    help="shrink the mix (CI smoke)")
+    ap.add_argument("--json", action="store_true",
+                    help="suppress the human summary, emit only the "
+                         "report line")
+    ap.add_argument("--p50-tolerance", type=float, default=0.002,
+                    help="p50 slack in seconds for the <= comparison")
+    args = ap.parse_args()
+
+    rounds = (8, 3, 12) if args.quick else (16, 6, 24)
+    from lighthouse_tpu.observability import report as obs_report
+
+    rep = obs_report.make("probe_autotune", params={
+        "rounds_trickle": rounds[0], "rounds_burst": rounds[1],
+        "rounds_small": rounds[2], "p50_tolerance": args.p50_tolerance,
+        "cpu_stall_every": CPU_STALL_EVERY,
+        "cpu_stall_extra_s": CPU_STALL_EXTRA,
+        "dev_cold_extra_s": DEV_COLD_EXTRA,
+    })
+
+    bundle_dir = tempfile.mkdtemp(prefix="probe_autotune_bundle_")
+    static = run_config(False, *rounds)
+    auto = run_config(True, *rounds, bundle_dir=bundle_dir)
+    restored = restored_node_summary(bundle_dir)
+
+    hit_ok = (auto["hit_rate"] is not None and static["hit_rate"] is not None
+              and auto["hit_rate"] >= static["hit_rate"])
+    p50_ok = (auto["p50_batch_seconds"] is not None
+              and static["p50_batch_seconds"] is not None
+              and auto["p50_batch_seconds"]
+              <= static["p50_batch_seconds"] + args.p50_tolerance)
+    static_clean = static["decisions"] == []
+    results = {
+        "static": static,
+        "autotuned": auto,
+        "restored_node": restored,
+        "comparison": {
+            "hit_rate_ok": hit_ok,
+            "p50_ok": p50_ok,
+            "static_untouched": static_clean,
+        },
+    }
+    ok = hit_ok and p50_ok and static_clean
+
+    if not args.json:
+        print(f"probe_autotune: mix = {rounds[0]} trickle + {rounds[1]} "
+              f"burst + {rounds[2]} small rounds per config",
+              file=sys.stderr)
+        for name, r in (("static", static), ("autotuned", auto)):
+            print(f"  {name:>9}: hit_rate={r['hit_rate']} "
+                  f"p50={r['p50_batch_seconds']}s "
+                  f"margin={r['close_margin_s']}s "
+                  f"cutoff={r['router_cutoff']} routes={r['by_route']}",
+                  file=sys.stderr)
+        print(f"  autotune decisions: "
+              f"{[d['knob'] for d in auto['decisions']]}", file=sys.stderr)
+        print(f"  restored node: inherited {len(restored['applied'])} "
+              f"facet(s), {int(restored['table_restored'])} table entries",
+              file=sys.stderr)
+        print(f"  verdict: hit_rate_ok={hit_ok} p50_ok={p50_ok} "
+              f"static_untouched={static_clean} -> "
+              f"{'PASS' if ok else 'FAIL'}", file=sys.stderr)
+    obs_report.emit(obs_report.finish(rep, ok=ok, results=results))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

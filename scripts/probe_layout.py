@@ -12,6 +12,10 @@ elementwise primitive's batching rule).
 Measurement discipline per NOTES: chained dependency loop inside ONE
 jitted call (lax.scan), forced np.asarray fetch, best-of-3.
 
+Emits one probe-report JSON line (observability/report.py schema) on
+stdout; the human-readable table goes to stderr so sweeps can pipe the
+schema line straight into a collector.
+
 Usage: python scripts/probe_layout.py [n] [chain]
 """
 
@@ -56,12 +60,16 @@ def bench(name, fn, x):
         dt = time.perf_counter() - t0
         best = min(best, dt)
     per = best / CHAIN
-    print(f"{name:34s} total {best*1e3:8.2f} ms   {per*1e6:9.1f} us/op")
+    print(f"{name:34s} total {best*1e3:8.2f} ms   {per*1e6:9.1f} us/op",
+          file=sys.stderr)
     return per
 
 
 def main():
-    print(f"devices: {jax.devices()}  n={N} chain={CHAIN}")
+    from lighthouse_tpu.observability import report as obs_report
+
+    rep = obs_report.make("probe_layout", {"n": N, "chain": CHAIN})
+    print(f"devices: {jax.devices()}  n={N} chain={CHAIN}", file=sys.stderr)
     rng = np.random.default_rng(0)
     # Valid lazy Fp12 inputs: canonical digits (small, within every bound).
     base = rng.integers(0, 256, size=(N, 2, 3, 2, lb.L)).astype(np.float32)
@@ -108,11 +116,19 @@ def main():
     results["mul/split"] = bench("fp_mul split (lead+128 lanes)", f_msplit,
                                  fb_s)
 
-    print()
+    print(file=sys.stderr)
+    speedups = {}
     for k in ("sqr", "mul"):
         lead = results[f"{k}/lead"]
         for v in ("tail", "split"):
-            print(f"{k}/{v}: {lead / results[f'{k}/{v}']:5.2f}x vs leading")
+            speedups[f"{k}/{v}"] = round(lead / results[f"{k}/{v}"], 3)
+            print(f"{k}/{v}: {speedups[f'{k}/{v}']:5.2f}x vs leading",
+                  file=sys.stderr)
+    obs_report.emit(obs_report.finish(
+        rep, ok=True,
+        results={"us_per_op": {k: round(v * 1e6, 2)
+                               for k, v in results.items()},
+                 "speedup_vs_leading": speedups}))
 
 
 if __name__ == "__main__":

@@ -12,6 +12,9 @@ Per (n_sets, k) shape: stages once, times sharded steady-state execution,
 reports sigs/s and per-device scaling. A poisoned variant runs through
 the same executables to confirm failure isolation under sharding.
 
+Emits one probe-report JSON line (observability/report.py schema) on
+stdout; human-readable output rides stderr.
+
 LIGHTHOUSE_TPU_LAYOUT selects the engine (round 6): "major" probes the
 batch-major lead-axis sharding, "bm" the batch-minor TRAILING-axis
 sharding (parallel/mesh.minor_sharding); the default "auto" resolves
@@ -37,10 +40,16 @@ def main():
     from lighthouse_tpu.ops import backend as be
     from lighthouse_tpu.parallel import mesh as pm
 
+    from lighthouse_tpu.observability import report as obs_report
+
     n_dev = len(jax.devices())
     layout = be._layout()
     print(f"devices: {n_dev} x {jax.devices()[0].platform} "
           f"(layout {layout})", file=sys.stderr)
+    rep = obs_report.make("probe_sharded",
+                          {"n_sets": n_sets, "ks": ks, "layout": layout,
+                           "n_devices": n_dev})
+    results = {}
     mesh = pm.get_mesh()
     sh = pm.batch_sharding(mesh)
 
@@ -90,10 +99,20 @@ def main():
                 mask, sc))
         assert not bool(step(*bad)), "poison must fail sharded"
 
+        results[f"k={k}"] = {
+            "steady_s": round(dt, 4),
+            "sigs_per_s": round(n_sets / dt, 1),
+            "sigs_per_s_per_dev": round(n_sets / dt / n_dev, 1),
+            "stage_s": round(stage_s, 3),
+            "compile_first_s": round(compile_s, 2),
+            "poison_isolated": True,
+        }
         print(f"n={n_sets} k={k} devs={n_dev} [{layout}]: "
               f"steady {dt:.3f}s -> {n_sets / dt:.1f} sigs/s "
               f"({n_sets / dt / n_dev:.1f}/dev; stage {stage_s:.2f}s, "
-              f"compile+first {compile_s:.1f}s)")
+              f"compile+first {compile_s:.1f}s)", file=sys.stderr)
+
+    obs_report.emit(obs_report.finish(rep, ok=True, results=results))
 
 
 if __name__ == "__main__":

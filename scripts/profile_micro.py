@@ -5,6 +5,9 @@ shapes to locate the bottleneck (MXU matmul vs elementwise carry/CRT
 machinery vs fixed latency). Informs NOTES_TPU_PERF.md's roofline and
 the round-4 fusion work.
 
+Emits one probe-report JSON line (observability/report.py schema) on
+stdout; the per-op table rides stderr.
+
 Usage: python scripts/profile_micro.py [n_sets]
 """
 
@@ -107,7 +110,15 @@ def main():
     results["hash_to_g2_device (n)"] = bench(h2c.hash_to_g2_device, u)
 
     for k, v in results.items():
-        print(f"{k:36s} {v * 1e3:10.2f} ms")
+        print(f"{k:36s} {v * 1e3:10.2f} ms", file=sys.stderr)
+
+    from lighthouse_tpu.observability import report as obs_report
+
+    rep = obs_report.make("profile_micro", {"n_sets": n})
+    obs_report.emit(obs_report.finish(
+        rep, ok=True,
+        results={"ms_per_call": {k: round(v * 1e3, 4)
+                                 for k, v in results.items()}}))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,372 @@
+"""Tier-1: online serving autotuner (CPU-only, no jax, no sleeps).
+
+The knob-rule tests drive the metric families directly (the autotuner
+only ever sees the time-series, so synthetic counter traffic is a full
+simulation); the convergence smoke runs the real scheduler + router on
+a ManualSlotClock under a shifting mix and asserts the control loop
+reaches a fixed point. Bundle round-trip covers the persistence seam.
+"""
+
+import pytest
+
+from lighthouse_tpu.common.metrics import Registry
+
+
+def _reg():
+    return Registry()
+
+
+def _tuner(reg, **kw):
+    from lighthouse_tpu.serving.autotune import Autotuner
+
+    kw.setdefault("enabled", True)
+    return Autotuner(registry=reg, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Knob rules, driven by synthetic metric traffic
+# ---------------------------------------------------------------------------
+
+
+class _SchedStub:
+    def __init__(self, close_margin_s=0.05, default_latency_s=0.25):
+        self.close_margin_s = close_margin_s
+        self.default_latency_s = default_latency_s
+        self.router = None
+
+
+def test_widen_margin_on_deadline_misses():
+    from lighthouse_tpu.serving.scheduler import MARGIN_BUCKETS
+
+    reg = _reg()
+    hits = reg.counter("serving_scheduler_deadline_hits_total", "h")
+    misses = reg.counter("serving_scheduler_deadline_misses_total", "h")
+    reg.histogram("serving_deadline_margin_seconds", "h",
+                  buckets=MARGIN_BUCKETS)
+    sched = _SchedStub(close_margin_s=0.05)
+    at = _tuner(reg, scheduler=sched)
+    at.step(now=0.0)
+    hits.inc(5)
+    misses.inc(5)               # 50% hit rate: way under target
+    out = at.step(now=10.0)
+    assert [d.knob for d in out] == ["close_margin"]
+    assert sched.close_margin_s == pytest.approx(0.05 * 1.6)
+    assert reg.counter_vec("serving_autotune_decisions_total") \
+        .get("close_margin") == 1.0
+    assert reg.gauge("serving_autotune_close_margin_seconds").get() == \
+        pytest.approx(sched.close_margin_s)
+
+
+def test_widen_capped_and_idle_stable():
+    reg = _reg()
+    hits = reg.counter("serving_scheduler_deadline_hits_total", "h")
+    misses = reg.counter("serving_scheduler_deadline_misses_total", "h")
+    sched = _SchedStub(close_margin_s=0.9)
+    at = _tuner(reg, scheduler=sched, margin_bounds=(0.01, 1.0))
+    at.step(now=0.0)
+    misses.inc(10)
+    at.step(now=10.0)
+    assert sched.close_margin_s == 1.0        # clamped, not 1.44
+    misses.inc(10)
+    assert at.step(now=20.0) == []            # at the cap: no churn
+    # An idle window (counters frozen) below min_batches changes nothing.
+    hits.inc(0)
+    assert at.step(now=100.0) == []
+
+
+def test_narrow_margin_on_surplus():
+    from lighthouse_tpu.serving.scheduler import MARGIN_BUCKETS
+
+    reg = _reg()
+    hits = reg.counter("serving_scheduler_deadline_hits_total", "h")
+    reg.counter("serving_scheduler_deadline_misses_total", "h")
+    margin = reg.histogram("serving_deadline_margin_seconds", "h",
+                           buckets=MARGIN_BUCKETS)
+    sched = _SchedStub(close_margin_s=0.2)
+    at = _tuner(reg, scheduler=sched, surplus_ratio=8.0)
+    at.step(now=0.0)
+    hits.inc(10)                # 100% hits
+    for _ in range(10):
+        margin.observe(3.5)     # p50 margin >> 8 * 0.2
+    out = at.step(now=10.0)
+    assert [d.knob for d in out] == ["close_margin"]
+    assert sched.close_margin_s == pytest.approx(0.2 * 0.75)
+
+
+def test_router_cutoff_moves_to_measured_crossover():
+    from lighthouse_tpu.serving.router import CostModelRouter, LatencyTable
+
+    reg = _reg()
+    t = LatencyTable()
+    t.seed("cpu", 1, 0.001)     # linear: 1ms per set
+    t.seed("device", 64, 0.006)  # flat 6ms dispatch
+    router = CostModelRouter(table=t, small_batch_max=16, registry=reg)
+    at = _tuner(reg, router=router)
+    # This rule reads the table, not a window: it can act on step one.
+    out = at.step(now=0.0)
+    assert [d.knob for d in out] == ["router_cutoff"]
+    # cpu predicts cheaper through b=4 (4ms < 6ms), loses at b=8.
+    assert router.small_batch_max == 4
+    # Fixed point: the same table yields the same cutoff.
+    assert at.step(now=1.0) == []
+
+
+def test_cutoff_needs_both_routes_measured():
+    from lighthouse_tpu.serving.router import CostModelRouter, LatencyTable
+
+    reg = _reg()
+    t = LatencyTable()
+    t.seed("cpu", 16, 0.002)    # cpu only: no crossover evidence
+    router = CostModelRouter(table=t, small_batch_max=16, registry=reg)
+    at = _tuner(reg, router=router)
+    at.step(now=0.0)
+    assert at.step(now=1.0) == []
+    assert router.small_batch_max == 16
+
+
+def test_bucket_menu_and_warm_grid_repick():
+    from lighthouse_tpu.beacon_processor.processor import AdaptiveBatchPolicy
+
+    reg = _reg()
+    sizes = reg.histogram(
+        "serving_scheduler_batch_size_sets", "h",
+        buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+    distinct = reg.histogram(
+        "serving_batch_distinct_messages_sets", "h",
+        buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+    policy = AdaptiveBatchPolicy(max_bucket=1024)
+    at = _tuner(reg, batch_policy=policy, grid_ks=(1, 4))
+    at.step(now=0.0)
+    for _ in range(20):
+        sizes.observe(100)      # all traffic lands in (64, 128]
+        distinct.observe(1)     # committee-repeated messages
+    out = at.step(now=10.0)
+    knobs = [d.knob for d in out]
+    assert knobs == ["bucket_menu", "warm_grid", "m_menu"]
+    assert policy.max_bucket == 128
+    assert at._warm_grid == [(128, 1), (128, 4)]
+    # Only the catch-all shift and the one the traffic lands on survive.
+    assert 0 in at._m_shifts and len(at._m_shifts) < 5
+    # Fixed point under steady traffic.
+    for _ in range(20):
+        sizes.observe(100)
+        distinct.observe(1)
+    assert at.step(now=20.0) == []
+
+
+def test_menu_never_outgrows_the_initial_ceiling():
+    from lighthouse_tpu.beacon_processor.processor import AdaptiveBatchPolicy
+
+    reg = _reg()
+    sizes = reg.histogram(
+        "serving_scheduler_batch_size_sets", "h",
+        buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+    policy = AdaptiveBatchPolicy(max_bucket=64)
+    at = _tuner(reg, batch_policy=policy)
+    at.step(now=0.0)
+    for _ in range(20):
+        sizes.observe(200)      # p99 wants 256
+    at.step(now=10.0)
+    assert policy.max_bucket == 64   # backend ceiling wins
+
+
+def test_set_max_bucket_pow2_floor():
+    from lighthouse_tpu.beacon_processor.processor import AdaptiveBatchPolicy
+
+    p = AdaptiveBatchPolicy(max_bucket=1024)
+    p.set_max_bucket(100)
+    assert p.max_bucket == 64        # pow2 floor
+    p.set_max_bucket(1)
+    assert p.max_bucket == 2         # never below a real batch
+
+
+# ---------------------------------------------------------------------------
+# Kill switch
+# ---------------------------------------------------------------------------
+
+
+def test_env_kill_switch_disables_everything(monkeypatch):
+    from lighthouse_tpu.serving import autotune
+
+    monkeypatch.setenv(autotune.ENV_VAR, "0")
+    assert not autotune.enabled_from_env()
+    reg = _reg()
+    misses = reg.counter("serving_scheduler_deadline_misses_total", "h")
+    sched = _SchedStub(close_margin_s=0.05)
+    at = autotune.Autotuner(scheduler=sched, registry=reg)  # env-resolved
+    at.step(now=0.0)
+    misses.inc(10)
+    assert at.step(now=10.0) == []
+    assert sched.close_margin_s == 0.05      # static behavior intact
+    # Restores are gated by the same switch.
+    pol = {"policy_version": 1,
+           "scheduler": {"close_margin_s": 0.5}}
+    assert autotune.apply_policy(pol, scheduler=sched) == []
+    assert sched.close_margin_s == 0.05
+    monkeypatch.setenv(autotune.ENV_VAR, "1")
+    assert autotune.enabled_from_env()
+    assert autotune.apply_policy(pol, scheduler=sched) != []
+    assert sched.close_margin_s == 0.5
+
+
+# ---------------------------------------------------------------------------
+# Policy persistence: bundle-manifest round trip + restore
+# ---------------------------------------------------------------------------
+
+
+def test_policy_roundtrip_through_bundle_manifest(tmp_path):
+    from lighthouse_tpu.serving import aot
+    from lighthouse_tpu.serving.autotune import apply_policy
+    from lighthouse_tpu.serving.router import CostModelRouter, LatencyTable
+
+    reg = _reg()
+    t = LatencyTable()
+    t.seed("cpu", 4, 0.004)
+    t.seed("device", 64, 0.006)
+    router = CostModelRouter(table=t, small_batch_max=4, registry=reg)
+    from lighthouse_tpu.beacon_processor.processor import AdaptiveBatchPolicy
+
+    from lighthouse_tpu.serving.autotune import Autotuner
+
+    sched = _SchedStub(close_margin_s=0.08, default_latency_s=0.2)
+    at = Autotuner(scheduler=sched, router=router,
+                   batch_policy=AdaptiveBatchPolicy(max_bucket=128),
+                   registry=reg, enabled=True)
+    pol = at.save(str(tmp_path))
+    assert pol["policy_version"] == 1
+    assert pol["router"]["table"] == t.snapshot()
+
+    # The manifest survives on disk and reads back without jax gating.
+    loaded = aot.load_policy(str(tmp_path))
+    assert loaded == pol
+
+    # A fresh stack inherits the tuned state; restored table entries are
+    # counted on the restoring router's registry.
+    reg2 = _reg()
+    router2 = CostModelRouter(table=LatencyTable(), small_batch_max=16,
+                              registry=reg2)
+    sched2 = _SchedStub(close_margin_s=0.05)
+    policy2 = AdaptiveBatchPolicy(max_bucket=1024)
+    applied = apply_policy(loaded, scheduler=sched2, router=router2,
+                           batch_policy=policy2, check_env=False)
+    assert {d.knob for d in applied} >= {"close_margin", "router_cutoff",
+                                         "router_table", "bucket_menu"}
+    assert sched2.close_margin_s == 0.08
+    assert router2.small_batch_max == 4
+    assert router2.table.snapshot() == t.snapshot()
+    assert policy2.max_bucket == 128
+    assert reg2.counter(
+        "serving_router_table_restored_total").get() == 2.0
+
+    # Restored entries are seeds: live traffic still overrides them.
+    router2.table.observe("cpu", 4, 0.1)
+    assert router2.table.predict("cpu", 4) != 0.004
+
+
+def test_save_policy_preserves_bundle_entries(tmp_path):
+    """Policy writes must not clobber an existing bundle's stage entries
+    (the producer and the autotuner share one manifest)."""
+    import json
+    import os
+
+    from lighthouse_tpu.serving import aot
+
+    manifest = {"bundle_version": aot.BUNDLE_VERSION,
+                "jax_version": "x", "platform": "cpu",
+                "entries": {"core": {"stages": ["k1"]}},
+                "stages": {"k1": {"file": "f", "sha256": "s", "size": 1}}}
+    mpath = os.path.join(str(tmp_path), aot.MANIFEST_NAME)
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+    aot.save_policy(str(tmp_path), {"policy_version": 1, "max_bucket": 64})
+    out = json.loads(open(mpath).read())
+    assert out["entries"] == manifest["entries"]
+    assert out["stages"] == manifest["stages"]
+    assert out["policy"]["max_bucket"] == 64
+    assert aot.load_policy(str(tmp_path))["max_bucket"] == 64
+    # Absent policy reads as None, never raises.
+    assert aot.load_policy(str(tmp_path / "nope")) is None
+
+
+def test_malformed_policy_applies_nothing():
+    from lighthouse_tpu.serving.autotune import apply_policy
+
+    sched = _SchedStub(close_margin_s=0.05)
+    assert apply_policy(None, scheduler=sched, check_env=False) == []
+    assert apply_policy("garbage", scheduler=sched, check_env=False) == []
+    assert apply_policy({"scheduler": {"close_margin_s": -5}},
+                        scheduler=sched, check_env=False) == []
+    assert sched.close_margin_s == 0.05
+
+
+# ---------------------------------------------------------------------------
+# Convergence smoke: real scheduler + router on a manual clock
+# ---------------------------------------------------------------------------
+
+
+def test_autotuner_converges_on_shifting_mix():
+    """Miss-heavy bursts widen the accumulation margin; a healthy phase
+    narrows it back; under steady traffic the control loop reaches a
+    fixed point (consecutive steps emit no decisions)."""
+    from lighthouse_tpu.beacon_processor.processor import AdaptiveBatchPolicy
+    from lighthouse_tpu.common.slot_clock import ManualSlotClock
+    from lighthouse_tpu.crypto.bls import api
+    from lighthouse_tpu.serving.autotune import Autotuner
+    from lighthouse_tpu.serving.router import CostModelRouter, LatencyTable
+    from lighthouse_tpu.serving.scheduler import (
+        ContinuousBatchScheduler,
+        VerifyJob,
+    )
+
+    api.register_backend("_test_at_cpu", lambda sets: True)
+    reg = _reg()
+    router = CostModelRouter(table=LatencyTable(),
+                             cpu_backend="_test_at_cpu",
+                             small_batch_max=16, registry=reg)
+    clock = ManualSlotClock(genesis_time=0, seconds_per_slot=12)
+    sched = ContinuousBatchScheduler(
+        clock, policy=AdaptiveBatchPolicy(max_bucket=64), router=router,
+        close_margin_s=0.05, registry=reg)
+    at = Autotuner(scheduler=sched, router=router,
+                   batch_policy=sched.policy, registry=reg,
+                   window_s=30.0, margin_bounds=(0.01, 0.2),
+                   min_batches=2, enabled=True)
+
+    def burst(slot, late):
+        clock.set_slot(slot)
+        if late:   # submit with (almost) no budget left: guaranteed miss
+            clock.advance_seconds(4.0 - 1e-7)
+        for _ in range(4):
+            sched.submit(VerifyJob("gossip_attestation", "s"))
+        sched.run_until_idle()
+
+    # Phase 1 — deadline pressure: the margin must widen.
+    m0 = sched.close_margin_s
+    t = 0.0
+    at.step(now=t)
+    for i in range(4):
+        burst(10 + i, late=True)
+        t += 5.0
+        at.step(now=t)
+    assert sched.close_margin_s > m0
+    assert sched.stats.deadline_misses >= 4
+
+    # Phase 2 — healthy traffic (fresh-third budget, instant verify):
+    # surplus margin narrows the window back; the loop converges.
+    t = 100.0   # age the misses out of the 30s window
+    empties = 0
+    for i in range(40):
+        burst(100 + i, late=False)
+        t += 5.0
+        empties = empties + 1 if at.step(now=t) == [] else 0
+        if empties >= 3:
+            break
+    assert empties >= 3, "autotuner never reached a fixed point"
+    assert sched.close_margin_s <= m0 * 1.6 ** 4  # and pressure is gone
+    assert sched.close_margin_s == pytest.approx(0.01)  # narrowed to floor
+
+    # The decisions left an audit trail in the metrics.
+    dec = reg.counter_vec("serving_autotune_decisions_total")
+    assert dec.get("close_margin") >= 2.0
+    # And the re-picked menu tracked the observed batch size (4-set bursts).
+    assert sched.policy.max_bucket == 4

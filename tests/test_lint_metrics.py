@@ -1,6 +1,7 @@
-"""Tier-1 wiring for scripts/lint_metrics.py (ISSUE 13 satellite): the
-metric-name contract — registered once with help, snake_case, unit
-suffix — holds over the whole tree on every test run."""
+"""Tier-1 wiring for scripts/lint_metrics.py (ISSUE 13 satellite; label
+cardinality added in ISSUE 17): the metric contract — registered once
+with help, snake_case, unit suffix, bounded label names — holds over
+the whole tree on every test run."""
 
 import importlib.util
 import os
@@ -36,13 +37,39 @@ def test_linter_catches_bad_names(tmp_path, monkeypatch):
         'reg.counter("no_unit_suffix", "help b")\n'
         'reg.counter("dup_total", "help c")\n'
         'reg.counter("dup_total", "help d")\n'
-        'reg.counter("orphan_total")\n')
+        'reg.counter("orphan_total")\n'
+        'reg.counter_vec("by_peer_total", "help e", "peer_id")\n')
     (tmp_path / "scripts").mkdir()
     monkeypatch.setattr(lm, "REPO", str(tmp_path))
     findings, names = lm.lint()
-    assert len(names) == 4
+    assert len(names) == 5
     joined = "\n".join(findings)
     assert "not snake_case" in joined
     assert "lacks a unit suffix" in joined
     assert "2 sites" in joined
     assert "only ever looked up" in joined
+    assert "unbounded label 'peer_id'" in joined
+
+
+def test_linter_label_cardinality_rule(tmp_path, monkeypatch):
+    """The bounded-label rule reads the declared label NAMES, wherever
+    they appear: positional, `labels=(...)` kwarg, or behind a
+    multi-line adjacent-string help — and only at registration sites
+    (lookups carry no label declaration to judge)."""
+    lm = _load()
+    src = tmp_path / "lighthouse_tpu" / "m.py"
+    src.parent.mkdir()
+    src.write_text(
+        'reg.counter_vec("ok_total", "closed set", "route")\n'
+        'reg.histogram_vec("ok_seconds", "help"\n'
+        '                  " continued", labels=("engine", "stage"),\n'
+        '                  buckets=(0.1, 1.0))\n'
+        'reg.gauge_vec("bad_depth", "per-validator!", "validator_index")\n'
+        'reg.counter_vec("ok_total")\n')
+    (tmp_path / "scripts").mkdir()
+    monkeypatch.setattr(lm, "REPO", str(tmp_path))
+    findings, _names = lm.lint()
+    label_findings = [f for f in findings if "unbounded label" in f]
+    assert len(label_findings) == 1
+    assert "'validator_index'" in label_findings[0]
+    assert "bad_depth" in label_findings[0]

@@ -417,7 +417,79 @@ def test_scheduler_unmeasured_first_batch_uses_default_latency():
     assert sched.stats.batches == 1
 
 
-def test_router_device_failure_retries_on_cpu(monkeypatch):
+def test_scheduler_default_latency_is_per_route_not_global():
+    """Edge (ISSUE 17 satellite): the default stands in only when the
+    CHOSEN route has no measurements at all — device-side table entries
+    must not mask a cold cpu table, and a cpu measurement at another
+    bucket scales to the singleton instead of defaulting."""
+    from lighthouse_tpu.serving.scheduler import VerifyJob
+
+    clock, sched = _deadline_rig(close_margin_s=0.05, cpu_latency=None,
+                                 default_latency_s=0.25)
+    # Rich device data, empty cpu table; the singleton routes cpu
+    # (small rule), so the 0.006 device entry is irrelevant evidence.
+    sched.router.table.seed("device", 64, 0.006)
+    sched.submit(VerifyJob("gossip_attestation", "x"))
+    clock.advance_seconds(3.5)               # budget 0.5: 0.5-0.25 > 0.05
+    assert not sched.step()                  # default 0.25 governs
+    clock.advance_seconds(0.25)              # budget 0.25 - 0.25 <= margin
+    assert sched.step()
+    assert sched.stats.batches == 1
+
+    # A cpu entry at bucket 4 scales linearly down to the never-measured
+    # singleton (0.4 * 1/4 = 0.1): predicted, not defaulted.
+    clock2, sched2 = _deadline_rig(close_margin_s=0.05, cpu_latency=None,
+                                   default_latency_s=0.25)
+    sched2.router.table.seed("cpu", 4, 0.4)
+    sched2.submit(VerifyJob("gossip_attestation", "x"))
+    clock2.advance_seconds(3.7)              # budget 0.3: 0.3-0.1 > 0.05
+    assert not sched2.step()                 # default would have closed
+    clock2.advance_seconds(0.16)             # budget 0.14 - 0.1 <= 0.05
+    assert sched2.step()
+    assert sched2.stats.batches == 1
+
+
+def test_margin_histogram_negative_bucket_after_midslot_narrow():
+    """Edge (ISSUE 17 satellite): the autotuner narrowing close_margin_s
+    MID-SLOT is read live by the very next close decision (no cached
+    margin), and the deadline miss that narrowing can produce lands in
+    the exact negative MARGIN_BUCKETS bucket — a miss is a number on
+    /metrics, not a log line."""
+    import time as _time
+
+    from lighthouse_tpu.common.slot_clock import ManualSlotClock
+    from lighthouse_tpu.crypto.bls import api
+    from lighthouse_tpu.serving.router import CostModelRouter, LatencyTable
+    from lighthouse_tpu.serving.scheduler import (
+        MARGIN_BUCKETS, ContinuousBatchScheduler, VerifyJob)
+
+    api.register_backend("_test_margin_stall",
+                         lambda sets: _time.sleep(0.12) or True)
+    t = LatencyTable()
+    t.seed("cpu", 1, 0.02)
+    router = CostModelRouter(table=t, cpu_backend="_test_margin_stall",
+                             small_batch_max=16,
+                             registry=_fresh_registry())
+    clock = ManualSlotClock(genesis_time=0, seconds_per_slot=12)
+    clock.set_slot(10)
+    reg = _fresh_registry()
+    sched = ContinuousBatchScheduler(clock, router=router,
+                                     close_margin_s=0.5, registry=reg)
+    sched.submit(VerifyJob("gossip_attestation", "x"))
+    clock.advance_seconds(3.5)               # budget 0.5 - 0.02 <= 0.5:
+    sched.close_margin_s = 0.01              # ...but the narrow lands first
+    assert not sched.step()                  # kept accumulating
+    clock.advance_seconds(0.4999)            # budget ~1e-4: forced close
+    assert sched.step()
+    assert sched.stats.deadline_misses == 1  # 0.12s stall vs ~0 budget
+    counts, total, _sum = reg.histogram(
+        "serving_deadline_margin_seconds",
+        buckets=MARGIN_BUCKETS).snapshot()
+    assert total == 1
+    # margin = budget - dt ~= -0.12: the (-0.2, -0.1] bucket (index of
+    # bound -0.1), with (-0.5, -0.2] slack for scheduler wake-up jitter.
+    lo, hi = MARGIN_BUCKETS.index(-0.2), MARGIN_BUCKETS.index(-0.1)
+    assert counts[lo] + counts[hi] == 1
     """Satellite: a device-route exception (lost chip, stale bundle)
     retries once on the native CPU route, counted in
     serving_router_fallback_total; CPU failures propagate unretried."""

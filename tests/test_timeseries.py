@@ -1,0 +1,276 @@
+"""Tier-1: metric time-series ring buffer + SLO engine (CPU-only, no jax).
+
+Every test drives `TimeSeries` on an explicit manual clock (the `now=`
+parameter) so windowed semantics are deterministic — no sleeps.
+"""
+
+import pytest
+
+from lighthouse_tpu.common.metrics import Registry
+
+
+def _reg():
+    return Registry()
+
+
+def _ts(reg, **kw):
+    from lighthouse_tpu.observability.timeseries import TimeSeries
+
+    return TimeSeries(reg, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Registry.families()
+# ---------------------------------------------------------------------------
+
+
+def test_registry_families_snapshot():
+    reg = _reg()
+    c = reg.counter("x_total", "h")
+    h = reg.histogram("y_seconds", "h")
+    fams = reg.families()
+    assert fams == {"x_total": c, "y_seconds": h}
+    # A snapshot, not the live dict: later registrations don't appear.
+    reg.gauge("z_depth", "h")
+    assert "z_depth" not in fams
+
+
+# ---------------------------------------------------------------------------
+# Sampling + scalar windows
+# ---------------------------------------------------------------------------
+
+
+def test_counter_delta_and_rate():
+    reg = _reg()
+    c = reg.counter("jobs_total", "h")
+    ts = _ts(reg)
+    c.inc(5)
+    ts.sample(now=0.0)
+    c.inc(10)
+    ts.sample(now=10.0)
+    assert ts.value("jobs_total") == 15.0
+    assert ts.delta("jobs_total", 30.0, now=10.0) == 10.0
+    assert ts.rate("jobs_total", 30.0, now=10.0) == pytest.approx(1.0)
+
+
+def test_window_brackets_oldest_inside():
+    """The window picks the newest sample at/before the cut, not the
+    global oldest — a 5s window over 30s of samples reads ~5s of delta."""
+    reg = _reg()
+    c = reg.counter("t_total", "h")
+    ts = _ts(reg)
+    for i in range(7):          # t = 0, 5, 10, ... 30; +1 each step
+        c.inc()
+        ts.sample(now=i * 5.0)
+    assert ts.delta("t_total", 5.0, now=30.0) == 1.0
+    assert ts.delta("t_total", 12.0, now=30.0) == 3.0
+    assert ts.delta("t_total", None, now=30.0) == 6.0  # whole buffer
+
+
+def test_too_little_data_answers_none():
+    reg = _reg()
+    reg.counter("a_total", "h").inc()
+    ts = _ts(reg)
+    assert ts.delta("a_total", 10.0) is None      # no samples at all
+    ts.sample(now=0.0)
+    assert ts.delta("a_total", 10.0, now=0.0) is None  # single sample
+    assert ts.value("a_total") == 1.0              # instant still works
+    assert ts.value("missing_total") is None
+
+
+def test_labeled_children_and_summed_view():
+    reg = _reg()
+    v = reg.counter_vec("routed_total", "h", "route")
+    ts = _ts(reg)
+    v.labels("cpu").inc(2)
+    ts.sample(now=0.0)
+    v.labels("cpu").inc(3)
+    v.labels("device").inc(7)   # born mid-window
+    ts.sample(now=1.0)
+    assert ts.delta("routed_total", 10.0, ("cpu",), now=1.0) == 3.0
+    # A child born mid-window deltas from zero.
+    assert ts.delta("routed_total", 10.0, ("device",), now=1.0) == 7.0
+    # labels=None sums every child.
+    assert ts.delta("routed_total", 10.0, None, now=1.0) == 10.0
+
+
+def test_ring_buffer_capacity_bounds_memory():
+    reg = _reg()
+    reg.counter("c_total", "h")
+    ts = _ts(reg, capacity=8)
+    for i in range(100):
+        ts.sample(now=float(i))
+    assert len(ts) == 8
+    d = ts.describe()
+    assert d["samples"] == 8 and d["span_seconds"] == pytest.approx(7.0)
+
+
+# ---------------------------------------------------------------------------
+# Histogram windows + quantiles
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_window_quantile():
+    reg = _reg()
+    h = reg.histogram("lat_seconds", "h", buckets=(0.1, 0.2, 0.4, 0.8))
+    ts = _ts(reg)
+    h.observe(0.05)             # pre-window noise
+    ts.sample(now=0.0)
+    for _ in range(10):
+        h.observe(0.15)         # lands in (0.1, 0.2]
+    ts.sample(now=5.0)
+    q = ts.quantile("lat_seconds", 0.5, 30.0, now=5.0)
+    assert 0.1 < q <= 0.2
+    n, s = ts.hist_delta("lat_seconds", 30.0, now=5.0)
+    assert n == 10 and s == pytest.approx(1.5)
+    assert ts.mean("lat_seconds", 30.0, now=5.0) == pytest.approx(0.15)
+
+
+def test_quantile_negative_buckets():
+    """The deadline-margin family spans zero; quantiles must interpolate
+    inside negative buckets, and the edge-less first bucket answers its
+    upper bound rather than inventing a floor of 0."""
+    from lighthouse_tpu.serving.scheduler import MARGIN_BUCKETS
+
+    reg = _reg()
+    h = reg.histogram("margin_seconds", "h", buckets=MARGIN_BUCKETS)
+    ts = _ts(reg)
+    ts.sample(now=0.0)
+    for _ in range(8):
+        h.observe(-0.15)        # bucket (-0.2, -0.1]
+    ts.sample(now=1.0)
+    q = ts.quantile("margin_seconds", 0.5, 10.0, now=1.0)
+    assert -0.2 < q <= -0.1
+    # Everything below the lowest finite edge: its upper bound.
+    h2 = reg.histogram("m2_seconds", "h", buckets=MARGIN_BUCKETS)
+    ts2 = _ts(reg)
+    ts2.sample(now=0.0)
+    h2.observe(-99.0)
+    ts2.sample(now=1.0)
+    assert ts2.quantile("m2_seconds", 0.5, 10.0, now=1.0) == -2.0
+
+
+def test_quantile_overflow_bucket_clamps():
+    reg = _reg()
+    h = reg.histogram("o_seconds", "h", buckets=(0.1, 0.2))
+    ts = _ts(reg)
+    ts.sample(now=0.0)
+    h.observe(50.0)             # +Inf overflow
+    ts.sample(now=1.0)
+    assert ts.quantile("o_seconds", 0.5, 10.0, now=1.0) == 0.2
+
+
+def test_labeled_histogram_children():
+    reg = _reg()
+    hv = reg.histogram_vec("stage_seconds", "h", labels=("stage",),
+                           buckets=(0.1, 1.0))
+    ts = _ts(reg)
+    ts.sample(now=0.0)
+    hv.labels("pairing").observe(0.05)
+    hv.labels("prepare").observe(0.5)
+    ts.sample(now=1.0)
+    assert ts.hist_delta("stage_seconds", 10.0, ("pairing",),
+                         now=1.0) == (1, pytest.approx(0.05))
+    assert ts.quantile("stage_seconds", 0.5, 10.0, ("prepare",),
+                       now=1.0) > 0.1
+
+
+# ---------------------------------------------------------------------------
+# SLO engine
+# ---------------------------------------------------------------------------
+
+
+def _slo_rig(objectives, window_s=30.0):
+    from lighthouse_tpu.observability.slo import SloEngine
+
+    reg = _reg()
+    ts = _ts(reg)
+    eng = SloEngine(ts, objectives, window_s=window_s, registry=reg)
+    return reg, ts, eng
+
+
+def test_slo_ratio_min_met_and_breached():
+    from lighthouse_tpu.observability.slo import Objective
+
+    obj = Objective("hit_rate", "ratio_min", 0.9,
+                    "hits_total", bad_metric="misses_total", min_events=4)
+    reg, ts, eng = _slo_rig([obj])
+    hits, misses = reg.counter("hits_total", "h"), \
+        reg.counter("misses_total", "h")
+    ts.sample(now=0.0)
+    hits.inc(19)
+    misses.inc(1)
+    ts.sample(now=10.0)
+    ev = eng.evaluate(now=10.0)["hit_rate"]
+    assert ev.met is True and ev.measured == pytest.approx(0.95)
+    assert reg.gauge_vec("slo_status").get("hit_rate") == 1.0
+
+    misses.inc(30)              # collapse the ratio
+    ts.sample(now=20.0)
+    ev = eng.evaluate(now=20.0)["hit_rate"]
+    assert ev.met is False
+    assert reg.gauge_vec("slo_status").get("hit_rate") == 0.0
+    assert reg.counter_vec("slo_breaches_total").get("hit_rate") == 1.0
+
+
+def test_slo_no_evidence_answers_none():
+    from lighthouse_tpu.observability.slo import Objective
+
+    obj = Objective("hit_rate", "ratio_min", 0.9,
+                    "hits_total", bad_metric="misses_total", min_events=4)
+    reg, ts, eng = _slo_rig([obj])
+    hits = reg.counter("hits_total", "h")
+    reg.counter("misses_total", "h")
+    ts.sample(now=0.0)
+    hits.inc(2)                 # below min_events
+    ts.sample(now=1.0)
+    ev = eng.evaluate(now=1.0)["hit_rate"]
+    assert ev.met is None
+    # No gauge write, no breach: an empty window is not a breach.
+    assert reg.counter_vec("slo_breaches_total").get("hit_rate") == 0.0
+
+
+def test_slo_quantile_max_and_rate_max():
+    from lighthouse_tpu.observability.slo import Objective
+
+    objs = [
+        Objective("p50_lat", "quantile_max", 0.3, "lat_seconds", q=0.5,
+                  min_events=4),
+        Objective("fallbacks", "rate_max", 0.5, "fb_total",
+                  labels=("retried",), min_events=1),
+    ]
+    reg, ts, eng = _slo_rig(objs)
+    lat = reg.histogram("lat_seconds", "h", buckets=(0.1, 0.2, 0.4, 0.8))
+    fb = reg.counter_vec("fb_total", "h", "outcome")
+    fb.labels("retried")        # family exists with a zero child
+    ts.sample(now=0.0)
+    for _ in range(8):
+        lat.observe(0.15)
+    ts.sample(now=10.0)
+    out = eng.evaluate(now=10.0)
+    assert out["p50_lat"].met is True
+    # Zero fallbacks over a live window IS evidence: met.
+    assert out["fallbacks"].met is True and \
+        out["fallbacks"].measured == 0.0
+
+    fb.labels("retried").inc(20)   # 2/s over the 10s window
+    ts.sample(now=20.0)
+    out = eng.evaluate(now=20.0)
+    assert out["fallbacks"].met is False
+
+
+def test_slo_objective_validation():
+    from lighthouse_tpu.observability.slo import Objective
+
+    with pytest.raises(ValueError):
+        Objective("x", "bogus_kind", 1.0, "m_total")
+    with pytest.raises(ValueError):
+        Objective("x", "ratio_min", 1.0, "m_total")  # no bad_metric
+
+
+def test_stock_serving_objectives_cover_the_trio():
+    from lighthouse_tpu.observability.slo import serving_objectives
+
+    names = {o.name for o in serving_objectives()}
+    assert names == {"deadline_hit_rate", "batch_latency_p50",
+                     "route_fallback_rate"}
